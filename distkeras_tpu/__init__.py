@@ -1,0 +1,34 @@
+"""distkeras_tpu — a TPU-native distributed deep-learning framework.
+
+A ground-up, TPU-first re-design of the capabilities of dist-keras
+(ExpediaInc/dist-keras): data-parallel training of neural networks with a
+family of synchronous and asynchronous optimization algorithms (DOWNPOUR,
+EASGD/AEASGD/EAMSGD, DynSGD, ADAG), a partitioned-dataset pipeline vocabulary
+(Transformers, Predictors, Evaluators), and batch inference — expressed on
+top of JAX/XLA: ``jit``-compiled training steps on the MXU, ``shard_map`` +
+``lax.psum`` collectives over an ICI device mesh for synchronous data
+parallelism, and a host-driven center-variable executor for the asynchronous
+algorithms' staleness semantics.
+
+Reference parity map (reference: distkeras/*.py; see SURVEY.md §2):
+
+- ``distkeras/trainers.py``            → :mod:`distkeras_tpu.trainers`
+- ``distkeras/workers.py``             → :mod:`distkeras_tpu.workers`
+- ``distkeras/parameter_servers.py``   → :mod:`distkeras_tpu.parameter_servers`
+- ``distkeras/networking.py``          → :mod:`distkeras_tpu.networking` and
+  :mod:`distkeras_tpu.parallel` (mesh collectives replace pickle-over-TCP)
+- ``distkeras/utils.py``               → :mod:`distkeras_tpu.utils`
+- ``distkeras/transformers.py``        → :mod:`distkeras_tpu.transformers`
+- ``distkeras/predictors.py``          → :mod:`distkeras_tpu.predictors`
+- ``distkeras/evaluators.py``          → :mod:`distkeras_tpu.evaluators`
+
+Capabilities beyond the reference: checkpoint/resume (orbax), structured
+metrics, profiling hooks, tensor/sequence parallelism (ring attention),
+and a real test suite.
+"""
+
+__version__ = "0.1.0"
+
+from distkeras_tpu.data.dataset import PartitionedDataset  # noqa: F401
+
+__all__ = ["PartitionedDataset", "__version__"]

@@ -1,0 +1,3 @@
+"""Partitioned, columnar datasets — the Spark-RDD/DataFrame stand-in."""
+
+from distkeras_tpu.data.dataset import PartitionedDataset  # noqa: F401

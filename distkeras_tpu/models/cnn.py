@@ -1,0 +1,56 @@
+"""Convolutional models for the MNIST / CIFAR-10 benchmark workloads.
+
+Reference: examples/ MNIST + CIFAR-10 notebooks build small Keras
+Conv2D/MaxPool/Dense models. These are the flax equivalents, NHWC layout
+(TPU-native), compute in a configurable dtype (bfloat16 by default for the
+MXU) with float32 logits.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from distkeras_tpu.models.registry import register_model
+
+
+@register_model("mnist_cnn")
+class MNISTCNN(nn.Module):
+    """Conv(32)-Conv(64)-pool-Dense(128)-Dense(10), MNIST-shaped [B,28,28,1]."""
+
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        x = nn.relu(nn.Conv(32, (3, 3), dtype=self.dtype)(x))
+        x = nn.relu(nn.Conv(64, (3, 3), dtype=self.dtype)(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(128, dtype=self.dtype)(x))
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+
+
+@register_model("cifar_cnn")
+class CIFARCNN(nn.Module):
+    """VGG-style 3-block CNN, CIFAR-shaped [B,32,32,3].
+
+    The throughput workload for BASELINE.md configs 3–4 (CIFAR-10
+    samples/sec/chip). Widths are multiples of 64/128 to tile the MXU.
+    """
+
+    num_classes: int = 10
+    widths: tuple = (64, 128, 256)
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        for w in self.widths:
+            x = nn.relu(nn.Conv(w, (3, 3), dtype=self.dtype)(x))
+            x = nn.relu(nn.Conv(w, (3, 3), dtype=self.dtype)(x))
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(256, dtype=self.dtype)(x))
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)

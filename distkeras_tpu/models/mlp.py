@@ -1,0 +1,30 @@
+"""MLP — the reference's MNIST multilayer-perceptron example model.
+
+Reference: examples/ MNIST workflow notebook builds a Keras Sequential
+Dense(relu)×2 + softmax head; this is the flax equivalent. Logits are
+returned un-softmaxed (losses fold in the softmax for numerical stability
+and XLA fusion).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from distkeras_tpu.models.registry import register_model
+
+
+@register_model("mlp")
+class MLP(nn.Module):
+    features: Sequence[int] = (500, 250)
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.reshape((x.shape[0], -1)).astype(self.dtype)
+        for f in self.features:
+            x = nn.relu(nn.Dense(f, dtype=self.dtype)(x))
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)

@@ -1,0 +1,42 @@
+"""Name → flax-module registry (the Keras ``to_json`` stand-in)."""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+_REGISTRY: Dict[str, Callable] = {}
+
+
+def register_model(name: str):
+    """Class decorator: register a flax module under ``name``."""
+
+    def deco(cls):
+        _REGISTRY[name] = cls
+        cls._registry_name = name
+        return cls
+
+    return deco
+
+
+def get_model(name: str, **kwargs):
+    """Instantiate a registered model by name."""
+    try:
+        cls = _REGISTRY[name]
+    except KeyError:
+        raise ValueError(f"Unknown model '{name}'. Known: {sorted(_REGISTRY)}") from None
+    return cls(**kwargs)
+
+
+def model_spec(module) -> dict:
+    """``{name, kwargs}`` spec for a registered module instance, suitable for
+    :func:`distkeras_tpu.utils.serde.serialize_model`."""
+    name = getattr(type(module), "_registry_name", None)
+    if name is None:
+        raise ValueError(f"{type(module).__name__} is not a registered model")
+    # flax dataclass fields are the constructor kwargs
+    kwargs = {
+        f: getattr(module, f)
+        for f in module.__dataclass_fields__
+        if f not in ("parent", "name")
+    }
+    return {"name": name, "kwargs": kwargs}

@@ -1,0 +1,119 @@
+"""TransformerLM — the flagship long-context model.
+
+The reference has no attention models (SURVEY.md §5.7) — its workloads are
+MLP/CNN-scale. This module is the framework's capability extension for
+long-context, multi-chip training: a pre-norm decoder-only transformer whose
+attention implementation is pluggable so the same module runs
+
+- single-chip with standard fused causal attention, or
+- sequence-parallel with ring attention over a mesh axis
+  (:mod:`distkeras_tpu.ops.ring_attention`), activated by constructing with
+  ``attention='ring'`` inside a ``shard_map`` over the sequence axis.
+
+Design notes for the MXU/HBM: bfloat16 activations, d_model/heads sized in
+multiples of 128, single einsum per projection, no data-dependent control
+flow (jit-stable static shapes).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distkeras_tpu.models.registry import register_model
+
+
+def sinusoidal_positions(max_len: int, dim: int) -> np.ndarray:
+    pos = np.arange(max_len)[:, None]
+    i = np.arange(dim // 2)[None, :]
+    angle = pos / np.power(10000.0, 2 * i / dim)
+    out = np.zeros((max_len, dim), dtype=np.float32)
+    out[:, 0::2] = np.sin(angle)
+    out[:, 1::2] = np.cos(angle)
+    return out
+
+
+class CausalSelfAttention(nn.Module):
+    num_heads: int
+    dtype: jnp.dtype = jnp.bfloat16
+    attention: str = "standard"  # 'standard' | 'ring'
+    seq_axis: str = "sp"  # mesh axis name used when attention == 'ring'
+
+    @nn.compact
+    def __call__(self, x):
+        B, T, D = x.shape
+        H = self.num_heads
+        hd = D // H
+        qkv = nn.DenseGeneral((3, H, hd), dtype=self.dtype, name="qkv")(x)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # [B, T, H, hd]
+        if self.attention == "ring":
+            from distkeras_tpu.ops.ring_attention import ring_attention
+
+            out = ring_attention(q, k, v, axis_name=self.seq_axis, causal=True)
+        else:
+            scale = 1.0 / np.sqrt(hd)
+            logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+            mask = jnp.tril(jnp.ones((T, T), dtype=bool))
+            logits = jnp.where(mask[None, None], logits, -1e30)
+            probs = jnp.exp(logits - logits.max(-1, keepdims=True))
+            probs = probs / probs.sum(-1, keepdims=True)
+            out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(self.dtype), v)
+        return nn.DenseGeneral(D, axis=(-2, -1), dtype=self.dtype, name="out")(out)
+
+
+class Block(nn.Module):
+    num_heads: int
+    mlp_ratio: int = 4
+    dtype: jnp.dtype = jnp.bfloat16
+    attention: str = "standard"
+    seq_axis: str = "sp"
+
+    @nn.compact
+    def __call__(self, x):
+        D = x.shape[-1]
+        h = nn.LayerNorm(dtype=self.dtype)(x)
+        x = x + CausalSelfAttention(
+            self.num_heads, self.dtype, self.attention, self.seq_axis
+        )(h)
+        h = nn.LayerNorm(dtype=self.dtype)(x)
+        h = nn.Dense(D * self.mlp_ratio, dtype=self.dtype)(h)
+        h = nn.gelu(h)
+        h = nn.Dense(D, dtype=self.dtype)(h)
+        return x + h
+
+
+@register_model("transformer_lm")
+class TransformerLM(nn.Module):
+    """Decoder-only LM: tokens [B, T] int32 → logits [B, T, vocab] f32."""
+
+    vocab_size: int = 1024
+    d_model: int = 256
+    num_heads: int = 4
+    num_layers: int = 4
+    max_len: int = 2048
+    dtype: jnp.dtype = jnp.bfloat16
+    attention: str = "standard"
+    seq_axis: str = "sp"
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = False):
+        x = nn.Embed(self.vocab_size, self.d_model, dtype=self.dtype)(tokens)
+        # With ring attention each shard holds a T/sp slice of the sequence,
+        # so positions must be *global*: shard_index * T_local + local offset.
+        pos_table = jnp.asarray(sinusoidal_positions(self.max_len, self.d_model))
+        local_pos = jnp.arange(x.shape[1])
+        if self.attention == "ring":
+            offset = jax.lax.axis_index(self.seq_axis) * x.shape[1]
+            local_pos = local_pos + offset
+        x = x + jnp.take(pos_table, local_pos, axis=0)[None].astype(self.dtype)
+        for _ in range(self.num_layers):
+            x = Block(
+                self.num_heads,
+                dtype=self.dtype,
+                attention=self.attention,
+                seq_axis=self.seq_axis,
+            )(x)
+        x = nn.LayerNorm(dtype=self.dtype)(x)
+        return nn.Dense(self.vocab_size, dtype=jnp.float32)(x)

@@ -1,0 +1,3 @@
+"""Pure-functional building blocks: update rules, losses, and kernels."""
+
+from distkeras_tpu.ops import rules  # noqa: F401

@@ -1,0 +1,92 @@
+"""Ring attention — sequence-parallel exact attention over a mesh axis.
+
+The reference has no attention and no sequence parallelism (SURVEY.md §5.7);
+this is the framework's long-context capability. Algorithm (Liu, Zaheer,
+Abbeel — "Ring Attention with Blockwise Transformers"): shard the sequence
+over a mesh axis; each device holds a Q/K/V block of shape
+``[B, T/N, H, hd]``; K/V blocks rotate around the ring with
+``lax.ppermute`` over ICI while each device accumulates its queries' output
+with a streaming (flash-style) log-sum-exp softmax. Compute/communication
+overlap is left to XLA's async collective scheduling; per-step work is one
+``[Tq, Tk]`` block matmul per head — MXU-shaped.
+
+Must be called inside ``shard_map`` (or another context binding
+``axis_name``) with Q/K/V already sharded along the sequence dimension.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+
+
+def ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    axis_name: str,
+    causal: bool = True,
+) -> jnp.ndarray:
+    """Exact attention over a sequence sharded on ``axis_name``.
+
+    Args:
+      q, k, v: ``[B, T_local, H, head_dim]`` — this device's sequence shard.
+      axis_name: mesh axis the sequence is sharded over.
+      causal: apply a causal mask using *global* positions (shard index ×
+        T_local + local offset), so semantics match unsharded causal
+        attention exactly.
+
+    Returns:
+      ``[B, T_local, H, head_dim]`` in ``q.dtype``.
+    """
+    num_shards = jax.lax.psum(1, axis_name)
+    try:
+        num_shards = int(num_shards)
+    except TypeError as e:  # pragma: no cover - defensive
+        raise ValueError(
+            "ring_attention requires a statically-known axis size; call it "
+            "inside shard_map over a Mesh axis."
+        ) from e
+    my_shard = jax.lax.axis_index(axis_name)
+    B, T_local, H, hd = q.shape
+    scale = 1.0 / math.sqrt(hd)
+    qf = q.astype(jnp.float32) * scale
+    q_pos = my_shard * T_local + jnp.arange(T_local)
+
+    perm = [(j, (j + 1) % num_shards) for j in range(num_shards)]
+
+    def step(carry, i):
+        o, m, l, kc, vc = carry
+        # kc originated on shard (my_shard - i) mod N.
+        src = jnp.mod(my_shard - i, num_shards)
+        s = jnp.einsum("bqhd,bkhd->bhqk", qf, kc.astype(jnp.float32))
+        if causal:
+            k_pos = src * T_local + jnp.arange(T_local)
+            mask = q_pos[:, None] >= k_pos[None, :]
+            s = jnp.where(mask[None, None], s, _NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        corr = jnp.exp(m - m_new)  # [B, H, Tq]
+        p = jnp.exp(s - m_new[..., None])  # [B, H, Tq, Tk]
+        l_new = l * corr + p.sum(axis=-1)
+        corr_o = corr.transpose(0, 2, 1)[..., None]  # [B, Tq, H, 1]
+        o_new = o * corr_o + jnp.einsum(
+            "bhqk,bkhd->bqhd", p, vc.astype(jnp.float32)
+        )
+        kc = jax.lax.ppermute(kc, axis_name, perm)
+        vc = jax.lax.ppermute(vc, axis_name, perm)
+        return (o_new, m_new, l_new, kc, vc), None
+
+    # The accumulators are device-varying (each shard computes its own); mark
+    # them as varying over the ring axis or scan rejects the carry types.
+    o0 = jax.lax.pcast(jnp.zeros((B, T_local, H, hd), jnp.float32), axis_name, to='varying')
+    m0 = jax.lax.pcast(jnp.full((B, H, T_local), _NEG_INF, jnp.float32), axis_name, to='varying')
+    l0 = jax.lax.pcast(jnp.zeros((B, H, T_local), jnp.float32), axis_name, to='varying')
+    (o, _, l, _, _), _ = jax.lax.scan(
+        step, (o0, m0, l0, k, v), jnp.arange(num_shards)
+    )
+    denom = jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
+    return (o / denom).astype(q.dtype)

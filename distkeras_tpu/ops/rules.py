@@ -1,0 +1,221 @@
+"""Distributed-optimization update rules as pure pytree functions.
+
+This module is the algorithmic spec of the framework: every synchronization
+rule implemented procedurally in the reference (reference:
+distkeras/workers.py and distkeras/parameter_servers.py — delta accumulation
+in the worker loops, ``handle_commit`` on the parameter-server classes) is
+re-expressed here as a *pure function* over JAX pytrees so it can be
+
+1. unit-tested against the published math on fixed seeds,
+2. ``jit``-compiled and fused into device step functions, and
+3. reused identically by the synchronous (collective) and asynchronous
+   (host-driven center variable) execution paths.
+
+All functions take and return pytrees of arrays (``params``-shaped) and are
+side-effect free. Scalar hyperparameters are Python floats / ints (static
+under ``jit``) or 0-d arrays where they participate in traced math.
+
+Papers (as cited by the reference README):
+- DOWNPOUR: Dean et al., "Large Scale Distributed Deep Networks", NeurIPS'12.
+- EASGD / AEASGD / EAMSGD: Zhang, Choromanska, LeCun, "Deep learning with
+  Elastic Averaged SGD", NeurIPS'15.
+- DynSGD: Jiang et al., "Heterogeneity-aware Distributed Parameter Servers",
+  SIGMOD'17.
+- ADAG: Hermans, "Asynchronous Distributed Adaptive Gradients" (dist-keras
+  author's algorithm; normalized asynchronous gradient accumulation).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+Pytree = object  # documentation alias: any pytree of jnp arrays
+
+
+# ---------------------------------------------------------------------------
+# Generic pytree arithmetic
+# ---------------------------------------------------------------------------
+
+def tree_sub(a: Pytree, b: Pytree) -> Pytree:
+    """``a - b`` leafwise. The worker-side "delta" of every async algorithm.
+
+    Reference: distkeras/workers.py · DOWNPOURWorker.train computes
+    ``delta = new_weights - last_pulled_weights`` per layer with numpy.
+    """
+    return jax.tree.map(jnp.subtract, a, b)
+
+
+def tree_add(a: Pytree, b: Pytree) -> Pytree:
+    """``a + b`` leafwise."""
+    return jax.tree.map(jnp.add, a, b)
+
+
+def tree_scale(a: Pytree, s) -> Pytree:
+    """``s * a`` leafwise (``s`` scalar or 0-d array)."""
+    return jax.tree.map(lambda x: x * s, a)
+
+
+def tree_axpy(alpha, x: Pytree, y: Pytree) -> Pytree:
+    """``alpha * x + y`` leafwise."""
+    return jax.tree.map(lambda xi, yi: alpha * xi + yi, x, y)
+
+
+def tree_mean(trees: list) -> Pytree:
+    """Leafwise mean of a list of pytrees.
+
+    Reference: distkeras/trainers.py · AveragingTrainer — one-shot parameter
+    averaging of per-partition models.
+    """
+    n = len(trees)
+    return jax.tree.map(lambda *leaves: sum(leaves) / n, *trees)
+
+
+def tree_zeros_like(a: Pytree) -> Pytree:
+    return jax.tree.map(jnp.zeros_like, a)
+
+
+# ---------------------------------------------------------------------------
+# DOWNPOUR (Dean et al. 2012)
+# ---------------------------------------------------------------------------
+
+def downpour_delta(local: Pytree, last_pulled: Pytree) -> Pytree:
+    """The windowed delta a DOWNPOUR worker pushes after ``communication_window``
+    local steps.
+
+    Reference: distkeras/workers.py · DOWNPOURWorker — accumulated weight
+    delta vs. the last pulled center.
+    """
+    return tree_sub(local, last_pulled)
+
+
+def downpour_commit(center: Pytree, delta: Pytree) -> Pytree:
+    """Parameter-server commit: ``center += delta``.
+
+    Reference: distkeras/parameter_servers.py · DeltaParameterServer
+    .handle_commit.
+    """
+    return tree_add(center, delta)
+
+
+# ---------------------------------------------------------------------------
+# EASGD family (Zhang et al. 2015)
+# ---------------------------------------------------------------------------
+
+def elastic_difference(alpha, worker: Pytree, center: Pytree) -> Pytree:
+    """``alpha * (worker - center)`` — the elastic force between a worker and
+    the center variable. ``alpha = learning_rate * rho`` in the paper's
+    parameterization.
+
+    Reference: distkeras/workers.py · EASGDWorker / AEASGDWorker.
+    """
+    return tree_scale(tree_sub(worker, center), alpha)
+
+
+def easgd_worker_update(worker: Pytree, center: Pytree, alpha) -> Pytree:
+    """Elastic pull of the worker toward the center: ``w -= alpha*(w - c)``."""
+    return tree_sub(worker, elastic_difference(alpha, worker, center))
+
+
+def easgd_center_update(center: Pytree, workers: list, alpha) -> Pytree:
+    """Synchronous-round center update:
+    ``c += alpha * sum_i (w_i - c)``.
+
+    Reference: distkeras/parameter_servers.py · EASGDParameterServer — the
+    synchronous variant waits for all workers' commits, then moves the
+    center by the summed elastic forces.
+    """
+    force = tree_zeros_like(center)
+    for w in workers:
+        force = tree_add(force, tree_sub(w, center))
+    return tree_add(center, tree_scale(force, alpha))
+
+
+def aeasgd_commit(center: Pytree, elastic_diff: Pytree) -> Pytree:
+    """Asynchronous EASGD commit: the worker pushes its elastic difference
+    ``alpha*(w - c)`` and the server adds it: ``c += alpha*(w - c)``.
+
+    Reference: distkeras/parameter_servers.py · DeltaParameterServer serving
+    AEASGDWorker pushes (the elastic difference *is* the delta).
+    """
+    return tree_add(center, elastic_diff)
+
+
+def eamsgd_momentum_update(velocity: Pytree, grad_step: Pytree, momentum) -> Pytree:
+    """Nesterov-style momentum velocity update on the local worker:
+    ``v = momentum * v + step``.
+
+    Reference: distkeras/workers.py · EAMSGDWorker (AEASGD + momentum).
+    """
+    return jax.tree.map(lambda v, g: momentum * v + g, velocity, grad_step)
+
+
+# ---------------------------------------------------------------------------
+# DynSGD (Jiang et al. SIGMOD'17)
+# ---------------------------------------------------------------------------
+
+def dynsgd_scale(delta: Pytree, staleness) -> Pytree:
+    """Heterogeneity-aware commit scaling: ``delta / (staleness + 1)``.
+
+    ``staleness = server_clock - worker_clock_at_pull`` — how many commits
+    the center absorbed since this worker last pulled. Fresh updates
+    (staleness 0) apply at full strength; stale ones are damped
+    proportionally.
+
+    Reference: distkeras/parameter_servers.py · DynSGDParameterServer —
+    tracks a global clock and scales each incoming delta by 1/(staleness+1).
+    """
+    return tree_scale(delta, 1.0 / (staleness + 1.0))
+
+
+def dynsgd_commit(center: Pytree, delta: Pytree, staleness) -> Pytree:
+    """``center += delta / (staleness + 1)``."""
+    return tree_add(center, dynsgd_scale(delta, staleness))
+
+
+# ---------------------------------------------------------------------------
+# ADAG (Hermans)
+# ---------------------------------------------------------------------------
+
+def adag_commit(center: Pytree, delta: Pytree, num_workers: int) -> Pytree:
+    """Normalized asynchronous gradient accumulation:
+    ``center += delta / num_workers``.
+
+    Dividing by the worker count keeps the *expected* total step size
+    independent of parallelism — the key idea that made ADAG the reference's
+    recommended default.
+
+    Reference: distkeras/parameter_servers.py · ADAGParameterServer
+    .handle_commit (normalized/scaled accumulation).
+    """
+    return tree_add(center, tree_scale(delta, 1.0 / num_workers))
+
+
+# ---------------------------------------------------------------------------
+# Synchronous all-reduce forms (TPU-native expressions of the same math)
+# ---------------------------------------------------------------------------
+
+def allreduce_mean_delta(delta: Pytree, axis_name: str) -> Pytree:
+    """Mean of per-device deltas over a mesh axis — the SPMD form of
+    ADAG/DOWNPOUR commits when every device commits each window in lock-step.
+
+    ``psum(delta)/axis_size == sum_i delta_i / N`` which is exactly
+    :func:`adag_commit` applied once per device. Must be called inside
+    ``shard_map``/``pmap`` with ``axis_name`` bound.
+    """
+    n = jax.lax.psum(1, axis_name)
+    return jax.tree.map(lambda d: jax.lax.psum(d, axis_name) / n, delta)
+
+
+def allreduce_easgd_round(worker: Pytree, center: Pytree, alpha, axis_name: str):
+    """One synchronous EASGD round in SPMD form. Returns ``(new_worker,
+    new_center)`` where the center movement is the psum of elastic forces.
+
+    Semantically identical to :func:`easgd_center_update` +
+    :func:`easgd_worker_update` over all workers.
+    """
+    diff = tree_sub(worker, center)
+    new_worker = tree_sub(worker, tree_scale(diff, alpha))
+    total_force = jax.tree.map(lambda d: jax.lax.psum(d, axis_name), diff)
+    new_center = tree_add(center, tree_scale(total_force, alpha))
+    return new_worker, new_center

@@ -1,0 +1,5 @@
+"""Device-mesh collectives — the TPU-native replacement of the reference's
+communication backend (reference: distkeras/networking.py — pickle-over-TCP
+push/pull; here: ``jax.sharding.Mesh`` + XLA collectives over ICI)."""
+
+from distkeras_tpu.parallel.mesh import make_mesh, default_mesh  # noqa: F401

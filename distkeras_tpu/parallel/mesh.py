@@ -1,0 +1,51 @@
+"""Mesh construction helpers.
+
+The reference's process topology was "Spark driver + N executors" wired by
+TCP (reference: distkeras/networking.py · determine_host_address/connect).
+The TPU-native topology is a named device mesh; every collective in the
+framework addresses mesh axes by name:
+
+- ``dp`` — data parallel (batch-sharded; psum of grads/deltas)
+- ``tp`` — tensor parallel (weight-sharded matmuls)
+- ``sp`` — sequence parallel (ring attention over this axis)
+- ``pp`` — pipeline stages
+- ``ep`` — expert parallel (MoE)
+
+Axes of size 1 are legal and free, so a single program text covers every
+configuration from 1 chip to a multi-host pod slice.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+
+def make_mesh(axes: Dict[str, int], devices: Optional[Sequence] = None) -> Mesh:
+    """Build a ``Mesh`` with named ``axes`` (insertion order = major→minor).
+
+    ``prod(axes.values())`` must not exceed the device count; extra devices
+    are left unused (trailing slice).
+    """
+    if devices is None:
+        devices = jax.devices()
+    sizes = list(axes.values())
+    need = int(np.prod(sizes)) if sizes else 1
+    if need > len(devices):
+        raise ValueError(
+            f"mesh axes {axes} need {need} devices, have {len(devices)}"
+        )
+    grid = np.asarray(devices[:need], dtype=object).reshape(sizes)
+    return Mesh(grid, tuple(axes.keys()))
+
+
+def default_mesh(num_workers: Optional[int] = None) -> Mesh:
+    """1-D data-parallel mesh over the first ``num_workers`` devices
+    (default: all local devices) — the shape every reference trainer uses."""
+    devices = jax.devices()
+    n = num_workers or len(devices)
+    return make_mesh({"dp": n}, devices)

@@ -1,0 +1,10 @@
+"""Serde, losses/metrics registries, and history bookkeeping."""
+
+from distkeras_tpu.utils.serde import (  # noqa: F401
+    serialize_model,
+    deserialize_model,
+    serialize_pytree,
+    deserialize_pytree,
+)
+from distkeras_tpu.utils.losses import get_loss, get_metric  # noqa: F401
+from distkeras_tpu.utils.history import average_histories  # noqa: F401

@@ -1,0 +1,118 @@
+"""Loss and metric registries.
+
+Reference: distkeras/trainers.py · Trainer.__init__ takes ``loss`` and
+``metrics`` as Keras string names ('categorical_crossentropy', 'accuracy',
+…) forwarded to ``model.compile``. We keep the string-first API and resolve
+to pure JAX functions ``f(logits_or_preds, targets) -> scalar``.
+
+All losses reduce with a mean over the batch and are ``jit``-safe.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+
+LossFn = Callable[[jnp.ndarray, jnp.ndarray], jnp.ndarray]
+
+
+def categorical_crossentropy(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    """Softmax cross-entropy; ``labels`` one-hot ``[B, C]`` (reference keeps
+    labels one-hot via its OneHotTransformer)."""
+    return optax.softmax_cross_entropy(logits, labels).mean()
+
+
+def sparse_categorical_crossentropy(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    """Softmax cross-entropy with integer class labels ``[B]``."""
+    return optax.softmax_cross_entropy_with_integer_labels(
+        logits, labels.astype(jnp.int32)
+    ).mean()
+
+
+def binary_crossentropy(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    return optax.sigmoid_binary_cross_entropy(logits, labels).mean()
+
+
+def mean_squared_error(preds: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:
+    return jnp.mean(jnp.square(preds - targets))
+
+
+def mean_absolute_error(preds: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:
+    return jnp.mean(jnp.abs(preds - targets))
+
+
+_LOSSES = {
+    "categorical_crossentropy": categorical_crossentropy,
+    "sparse_categorical_crossentropy": sparse_categorical_crossentropy,
+    "binary_crossentropy": binary_crossentropy,
+    "mse": mean_squared_error,
+    "mean_squared_error": mean_squared_error,
+    "mae": mean_absolute_error,
+    "mean_absolute_error": mean_absolute_error,
+}
+
+
+def accuracy(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    """Classification accuracy; handles one-hot ``[B, C]`` or integer ``[B]``
+    labels (reference: distkeras/evaluators.py · AccuracyEvaluator)."""
+    pred = jnp.argmax(logits, axis=-1)
+    true = jnp.argmax(labels, axis=-1) if labels.ndim == logits.ndim else labels
+    return jnp.mean((pred == true.astype(pred.dtype)).astype(jnp.float32))
+
+
+_METRICS = {
+    "accuracy": accuracy,
+    "mse": mean_squared_error,
+    "mae": mean_absolute_error,
+}
+
+
+def get_loss(loss) -> LossFn:
+    """Resolve a loss by Keras-style name, or pass a callable through."""
+    if callable(loss):
+        return loss
+    try:
+        return _LOSSES[loss]
+    except KeyError:
+        raise ValueError(f"Unknown loss '{loss}'. Known: {sorted(_LOSSES)}") from None
+
+
+def get_metric(metric) -> LossFn:
+    """Resolve a metric by name, or pass a callable through."""
+    if callable(metric):
+        return metric
+    try:
+        return _METRICS[metric]
+    except KeyError:
+        raise ValueError(f"Unknown metric '{metric}'. Known: {sorted(_METRICS)}") from None
+
+
+def get_optimizer(name, learning_rate: float = 0.01, **kwargs) -> optax.GradientTransformation:
+    """Resolve a worker-side optimizer by Keras-style name.
+
+    Reference: distkeras/trainers.py · Trainer takes ``worker_optimizer`` as
+    a Keras optimizer string ('adagrad', 'adam', 'sgd', …) compiled into each
+    worker's local model. Accepts an ``optax.GradientTransformation`` as-is.
+    """
+    if isinstance(name, optax.GradientTransformation):
+        return name
+    table = {
+        "sgd": optax.sgd,
+        "momentum": lambda lr, **kw: optax.sgd(lr, momentum=kw.pop("momentum", 0.9), **kw),
+        "nesterov": lambda lr, **kw: optax.sgd(
+            lr, momentum=kw.pop("momentum", 0.9), nesterov=True, **kw
+        ),
+        "adam": optax.adam,
+        "adamw": optax.adamw,
+        "adagrad": optax.adagrad,
+        "rmsprop": optax.rmsprop,
+        "adadelta": optax.adadelta,
+    }
+    try:
+        factory = table[name]
+    except KeyError:
+        raise ValueError(f"Unknown optimizer '{name}'. Known: {sorted(table)}") from None
+    return factory(learning_rate, **kwargs)

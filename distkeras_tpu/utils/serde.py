@@ -1,0 +1,61 @@
+"""Model and pytree serialization.
+
+Reference: distkeras/utils.py · serialize_keras_model /
+deserialize_keras_model — the reference ships a model across process
+boundaries as ``{'model': model.to_json(), 'weights': model.get_weights()}``
+pickled onto a socket or into a Spark task closure.
+
+The TPU-native equivalent: a model is a ``(module, params)`` pair where
+``module`` is a flax ``nn.Module`` (pure apply function) and ``params`` is a
+pytree of arrays. We serialize params with flax's msgpack codec (compact,
+version-stable, no pickle for tensor payloads) and the module by name +
+constructor kwargs through the model registry
+(:mod:`distkeras_tpu.models`), so a serialized model is a small
+``{'model': {name, kwargs}, 'weights': msgpack_bytes}`` dict — the same
+shape as the reference's, with the unsafe pickle parts removed.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import numpy as np
+from flax import serialization as flax_serialization
+
+
+def serialize_pytree(tree: Any) -> bytes:
+    """Pytree of arrays → msgpack bytes (device arrays are fetched to host)."""
+    host_tree = jax.tree.map(np.asarray, tree)
+    return flax_serialization.to_bytes(host_tree)
+
+
+def deserialize_pytree(data: bytes, like: Optional[Any] = None) -> Any:
+    """msgpack bytes → pytree.
+
+    With ``like`` given, restores into the exact structure/dtypes of ``like``
+    (lists/tuples/custom nodes preserved). Without it, returns the raw nested
+    dict-of-ndarrays — sufficient for flax ``params`` dicts.
+    """
+    if like is not None:
+        return flax_serialization.from_bytes(like, data)
+    return flax_serialization.msgpack_restore(data)
+
+
+def serialize_model(module_spec: dict, params: Any) -> dict:
+    """``(module spec, params)`` → transportable dict.
+
+    ``module_spec`` is ``{'name': registered_model_name, 'kwargs': {...}}``
+    (see :func:`distkeras_tpu.models.get_model`), mirroring the reference's
+    ``{'model': to_json(), 'weights': get_weights()}`` layout.
+    """
+    return {"model": dict(module_spec), "weights": serialize_pytree(params)}
+
+
+def deserialize_model(blob: dict):
+    """Inverse of :func:`serialize_model` → ``(module, params)``."""
+    from distkeras_tpu.models import get_model
+
+    module = get_model(blob["model"]["name"], **blob["model"].get("kwargs", {}))
+    params = deserialize_pytree(blob["weights"])
+    return module, params

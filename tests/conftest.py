@@ -1,0 +1,39 @@
+"""Test configuration: run the whole suite on a virtual 8-device CPU mesh.
+
+Mirrors the reference's QA pattern (SURVEY.md §4): local-mode Spark
+(``local[N]``) gave N executors in one process so the full distributed path
+ran on a laptop; here ``--xla_force_host_platform_device_count=8`` gives 8
+XLA CPU devices so every mesh/collective/async path runs without TPU
+hardware. Must be set before JAX initializes a backend.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # the session presets axon (TPU); tests run on CPU
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# A sitecustomize in this image registers the TPU platform and sets the
+# jax_platforms *config* (not just the env var) at interpreter startup, so
+# the env override above is not enough — force the config back to cpu
+# before any backend initializes.
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    return jax.devices()
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    from distkeras_tpu.parallel.mesh import make_mesh
+
+    return make_mesh({"dp": 8})

@@ -1,0 +1,124 @@
+"""Tests for PartitionedDataset + transformer stages (reference parity:
+distkeras/transformers.py semantics on the DataFrame column contract)."""
+
+import numpy as np
+import pytest
+
+from distkeras_tpu.data.dataset import PartitionedDataset
+from distkeras_tpu.transformers import (
+    DenseTransformer,
+    LabelIndexTransformer,
+    MinMaxTransformer,
+    OneHotTransformer,
+    ReshapeTransformer,
+)
+
+
+def make_ds(n=100, num_partitions=4, seed=0):
+    rng = np.random.default_rng(seed)
+    return PartitionedDataset.from_arrays(
+        {
+            "features": rng.normal(size=(n, 8)).astype(np.float32),
+            "label": rng.integers(0, 10, size=n),
+        },
+        num_partitions=num_partitions,
+    )
+
+
+def test_from_arrays_partitioning():
+    ds = make_ds(103, 4)
+    assert ds.num_partitions == 4
+    assert ds.num_rows == 103
+    assert sorted(ds.columns) == ["features", "label"]
+    # partitions cover all rows in order
+    np.testing.assert_array_equal(
+        ds.column("label"),
+        np.concatenate([ds.partition(i)["label"] for i in range(4)]),
+    )
+
+
+def test_repartition_preserves_rows():
+    ds = make_ds(50, 2)
+    ds2 = ds.repartition(8)
+    assert ds2.num_partitions == 8
+    np.testing.assert_array_equal(ds.column("features"), ds2.column("features"))
+
+
+def test_shuffle_is_permutation_and_deterministic():
+    ds = make_ds(64, 4)
+    s1 = ds.shuffle(seed=7)
+    s2 = ds.shuffle(seed=7)
+    np.testing.assert_array_equal(s1.column("label"), s2.column("label"))
+    assert not np.array_equal(s1.column("label"), ds.column("label"))
+    np.testing.assert_array_equal(
+        np.sort(s1.column("label")), np.sort(ds.column("label"))
+    )
+
+
+def test_ragged_columns_rejected():
+    with pytest.raises(ValueError):
+        PartitionedDataset([{"a": np.zeros(3), "b": np.zeros(4)}])
+
+
+def test_onehot():
+    ds = make_ds(20, 2)
+    out = OneHotTransformer(10, "label", "label_encoded").transform(ds)
+    enc = out.column("label_encoded")
+    assert enc.shape == (20, 10)
+    np.testing.assert_array_equal(enc.argmax(-1), ds.column("label"))
+    np.testing.assert_allclose(enc.sum(-1), 1.0)
+
+
+def test_minmax():
+    ds = make_ds(30, 3)
+    out = MinMaxTransformer(
+        input_col="features", output_col="features_normalized"
+    ).transform(ds)
+    z = out.column("features_normalized")
+    assert z.min() >= 0.0 and z.max() <= 1.0 + 1e-6
+    # explicit observed range, reference-style ctor args
+    out2 = MinMaxTransformer(o_min=0.0, o_max=255.0, n_min=0.0, n_max=1.0,
+                             input_col="features", output_col="f2").transform(ds)
+    np.testing.assert_allclose(
+        out2.column("f2"), ds.column("features") / 255.0, rtol=1e-5
+    )
+
+
+def test_reshape():
+    rng = np.random.default_rng(1)
+    ds = PartitionedDataset.from_arrays(
+        {"features": rng.normal(size=(10, 784)).astype(np.float32)}, 2
+    )
+    out = ReshapeTransformer("features", "matrix", (28, 28, 1)).transform(ds)
+    assert out.column("matrix").shape == (10, 28, 28, 1)
+    np.testing.assert_array_equal(
+        out.column("matrix").reshape(10, -1), ds.column("features")
+    )
+
+
+def test_dense_transformer():
+    idx = np.empty(3, dtype=object)
+    vals = np.empty(3, dtype=object)
+    idx[0], vals[0] = [0, 2], [1.0, 2.0]
+    idx[1], vals[1] = [3], [5.0]
+    idx[2], vals[2] = [], []
+    ds = PartitionedDataset([{"indices": idx, "values": vals}])
+    out = DenseTransformer(4).transform(ds)
+    dense = out.column("features")
+    expect = np.array([[1, 0, 2, 0], [0, 0, 0, 5], [0, 0, 0, 0]], dtype=np.float32)
+    np.testing.assert_array_equal(dense, expect)
+
+
+def test_label_index():
+    pred = np.array([[0.1, 0.8, 0.1], [0.9, 0.05, 0.05]], dtype=np.float32)
+    ds = PartitionedDataset([{"prediction": pred}])
+    out = LabelIndexTransformer(3).transform(ds)
+    np.testing.assert_array_equal(out.column("predicted_index"), [1, 0])
+
+
+def test_with_column_and_select():
+    ds = make_ds(16, 2)
+    ds2 = ds.with_column("doubled", lambda p: p["features"] * 2)
+    np.testing.assert_allclose(ds2.column("doubled"), ds.column("features") * 2)
+    ds3 = ds2.select(["doubled"])
+    assert ds3.columns == ["doubled"]

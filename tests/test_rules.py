@@ -1,0 +1,158 @@
+"""Unit tests for the pure update rules against the published math.
+
+This is the numerical spec tier SURVEY.md §7 step 1 calls for: each
+reference algorithm's update rule (reference: distkeras/workers.py +
+distkeras/parameter_servers.py) checked leafwise on fixed seeds.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distkeras_tpu.ops import rules
+
+
+def make_tree(seed, scale=1.0):
+    rng = np.random.default_rng(seed)
+    return {
+        "dense": {"kernel": jnp.asarray(rng.normal(size=(4, 3)) * scale),
+                  "bias": jnp.asarray(rng.normal(size=(3,)) * scale)},
+        "out": {"kernel": jnp.asarray(rng.normal(size=(3, 2)) * scale)},
+    }
+
+
+def tree_allclose(a, b, **kw):
+    flat_a = jax.tree.leaves(a)
+    flat_b = jax.tree.leaves(b)
+    assert len(flat_a) == len(flat_b)
+    for x, y in zip(flat_a, flat_b):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y), **kw)
+
+
+def test_downpour_delta_and_commit_roundtrip():
+    center = make_tree(0)
+    local = make_tree(1)
+    delta = rules.downpour_delta(local, center)
+    # committing the delta onto the pulled center reproduces the local model
+    tree_allclose(rules.downpour_commit(center, delta), local, rtol=1e-6)
+
+
+def test_elastic_difference_math():
+    w = make_tree(2)
+    c = make_tree(3)
+    alpha = 0.25
+    diff = rules.elastic_difference(alpha, w, c)
+    expect = jax.tree.map(lambda a, b: alpha * (a - b), w, c)
+    tree_allclose(diff, expect, rtol=1e-6)
+    # worker moves toward center: distance strictly decreases
+    w2 = rules.easgd_worker_update(w, c, alpha)
+    d_before = sum(float(jnp.sum((a - b) ** 2)) for a, b in
+                   zip(jax.tree.leaves(w), jax.tree.leaves(c)))
+    d_after = sum(float(jnp.sum((a - b) ** 2)) for a, b in
+                  zip(jax.tree.leaves(w2), jax.tree.leaves(c)))
+    assert d_after < d_before
+
+
+def test_easgd_center_update_fixed_point():
+    # if all workers equal the center, the center does not move
+    c = make_tree(4)
+    out = rules.easgd_center_update(c, [c, c, c], alpha=0.5)
+    tree_allclose(out, c, rtol=1e-6)
+    # with symmetric workers c±d the center stays put too
+    d = make_tree(5, scale=0.1)
+    wp = rules.tree_add(c, d)
+    wm = rules.tree_sub(c, d)
+    out = rules.easgd_center_update(c, [wp, wm], alpha=0.3)
+    tree_allclose(out, c, rtol=1e-5, atol=1e-6)
+
+
+def test_aeasgd_commit_matches_sequential_easgd():
+    c = make_tree(6)
+    w = make_tree(7)
+    alpha = 0.1
+    diff = rules.elastic_difference(alpha, w, c)
+    c2 = rules.aeasgd_commit(c, diff)
+    expect = jax.tree.map(lambda cc, ww: cc + alpha * (ww - cc), c, w)
+    tree_allclose(c2, expect, rtol=1e-6)
+
+
+def test_dynsgd_staleness_scaling():
+    c = make_tree(8)
+    delta = make_tree(9, scale=0.01)
+    fresh = rules.dynsgd_commit(c, delta, staleness=0)
+    tree_allclose(fresh, rules.tree_add(c, delta), rtol=1e-6)
+    stale = rules.dynsgd_commit(c, delta, staleness=4)
+    expect = jax.tree.map(lambda cc, dd: cc + dd / 5.0, c, delta)
+    tree_allclose(stale, expect, rtol=1e-6)
+
+
+def test_adag_normalization():
+    c = make_tree(10)
+    delta = make_tree(11, scale=0.01)
+    n = 4
+    out = rules.adag_commit(c, delta, n)
+    expect = jax.tree.map(lambda cc, dd: cc + dd / n, c, delta)
+    tree_allclose(out, expect, rtol=1e-6)
+    # n workers each committing the same delta ≈ one full-strength commit
+    acc = c
+    for _ in range(n):
+        acc = rules.adag_commit(acc, delta, n)
+    tree_allclose(acc, rules.tree_add(c, delta), rtol=1e-5)
+
+
+def test_eamsgd_momentum():
+    v = rules.tree_zeros_like(make_tree(0))
+    g = make_tree(12, scale=0.1)
+    v1 = rules.eamsgd_momentum_update(v, g, momentum=0.9)
+    tree_allclose(v1, g, rtol=1e-6)
+    v2 = rules.eamsgd_momentum_update(v1, g, momentum=0.9)
+    expect = jax.tree.map(lambda gi: 1.9 * gi, g)
+    tree_allclose(v2, expect, rtol=1e-6)
+
+
+def test_tree_mean():
+    trees = [make_tree(s) for s in range(3)]
+    mean = rules.tree_mean(trees)
+    expect = jax.tree.map(lambda *ls: sum(ls) / 3.0, *trees)
+    tree_allclose(mean, expect, rtol=1e-6)
+
+
+def test_allreduce_mean_delta_matches_adag(mesh8):
+    """SPMD psum/N form == host-side adag_commit applied per worker."""
+    from jax.sharding import PartitionSpec as P
+    from jax import shard_map
+
+    rng = np.random.default_rng(13)
+    deltas = jnp.asarray(rng.normal(size=(8, 5)))
+
+    def f(d):
+        local = d[0]  # [5], this device's delta
+        return rules.allreduce_mean_delta(local, "dp")
+
+    out = shard_map(f, mesh=mesh8, in_specs=P("dp"), out_specs=P())(deltas)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(deltas.mean(0)),
+                               rtol=1e-6)
+
+
+def test_allreduce_easgd_round_matches_host_math(mesh8):
+    from jax.sharding import PartitionSpec as P
+    from jax import shard_map
+
+    rng = np.random.default_rng(14)
+    workers = jnp.asarray(rng.normal(size=(8, 6)))
+    center = jnp.asarray(rng.normal(size=(6,)))
+    alpha = 0.05
+
+    def f(w, c):
+        nw, nc = rules.allreduce_easgd_round(w[0], c, alpha, "dp")
+        return nw[None], nc
+
+    new_w, new_c = shard_map(
+        f, mesh=mesh8, in_specs=(P("dp"), P()), out_specs=(P("dp"), P())
+    )(workers, center)
+
+    host_c = rules.easgd_center_update(center, list(workers), alpha)
+    np.testing.assert_allclose(np.asarray(new_c), np.asarray(host_c), rtol=1e-5)
+    host_w0 = rules.easgd_worker_update(workers[0], center, alpha)
+    np.testing.assert_allclose(np.asarray(new_w[0]), np.asarray(host_w0), rtol=1e-5)
