@@ -30,5 +30,6 @@ and a real test suite.
 __version__ = "0.1.0"
 
 from distkeras_tpu.data.dataset import PartitionedDataset  # noqa: F401
+from distkeras_tpu.models.wrapper import Model  # noqa: F401
 
-__all__ = ["PartitionedDataset", "__version__"]
+__all__ = ["PartitionedDataset", "Model", "__version__"]

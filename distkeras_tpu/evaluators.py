@@ -1,0 +1,63 @@
+"""Evaluators — metric computation over a dataset.
+
+Reference: distkeras/evaluators.py · Evaluator / AccuracyEvaluator — a Spark
+stage comparing a label column against a prediction column with
+filter/count actions.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from distkeras_tpu.data.dataset import PartitionedDataset
+
+
+class Evaluator:
+    """Base: ``evaluate(dataset) -> float``."""
+
+    def evaluate(self, dataset: PartitionedDataset) -> float:
+        raise NotImplementedError
+
+
+class AccuracyEvaluator(Evaluator):
+    """Fraction of rows where prediction == label
+    (reference: evaluators.py · AccuracyEvaluator).
+
+    ``prediction_col`` may hold class indices (from LabelIndexTransformer)
+    or raw prediction vectors (argmax applied); ``label_col`` may be integer
+    or one-hot.
+    """
+
+    def __init__(self, prediction_col: str = "predicted_index",
+                 label_col: str = "label"):
+        self.prediction_col = prediction_col
+        self.label_col = label_col
+
+    def evaluate(self, dataset: PartitionedDataset) -> float:
+        pred = dataset.column(self.prediction_col)
+        label = dataset.column(self.label_col)
+        if pred.ndim > 1:
+            pred = pred.argmax(-1)
+        if label.ndim > 1:
+            label = label.argmax(-1)
+        return float(np.mean(pred.astype(np.int64) == label.astype(np.int64)))
+
+
+class LossEvaluator(Evaluator):
+    """Mean loss between a prediction column and a label column (no
+    reference counterpart; rounds out the evaluation vocabulary)."""
+
+    def __init__(self, loss: str = "mse", prediction_col: str = "prediction",
+                 label_col: str = "label"):
+        from distkeras_tpu.utils.losses import get_loss
+        import jax.numpy as jnp
+
+        self._loss_fn = get_loss(loss)
+        self._jnp = jnp
+        self.prediction_col = prediction_col
+        self.label_col = label_col
+
+    def evaluate(self, dataset: PartitionedDataset) -> float:
+        pred = self._jnp.asarray(dataset.column(self.prediction_col))
+        label = self._jnp.asarray(dataset.column(self.label_col))
+        return float(self._loss_fn(pred, label))

@@ -1,0 +1,194 @@
+"""Parameter servers — the center-variable owners.
+
+Reference: distkeras/parameter_servers.py. There the PS is a raw-TCP socket
+server in a background thread on the Spark driver: an accept loop, one
+handler thread per worker connection, a 1-byte action dispatch ('c' commit /
+'p' pull), and a global ``threading.Lock`` around the center weights.
+
+TPU-native redesign: the PS *role* (owner of the center variable, with
+per-algorithm commit semantics and genuine asynchrony/staleness) survives as
+a host-side object. Workers are threads driving jit-compiled device step
+loops (see :mod:`distkeras_tpu.workers`); they call ``pull``/``commit``
+directly — a method call under a lock in-process, or the same calls proxied
+over :mod:`distkeras_tpu.networking`'s transport from other hosts. The
+synchronous algorithms bypass this object entirely and use ICI collectives
+(``lax.psum`` inside ``shard_map`` — see distkeras_tpu/trainers.py ·
+DataParallelTrainer), which is the reason this framework scales where the
+reference's single-socket GIL-bound server did not (SURVEY.md §3.2).
+
+The commit math delegates to :mod:`distkeras_tpu.ops.rules`, the same pure
+functions the SPMD paths use — one spec, two execution engines.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+import jax
+import numpy as np
+
+from distkeras_tpu.ops import rules
+
+
+def _to_host(tree):
+    return jax.tree.map(np.asarray, tree)
+
+
+class ParameterServer:
+    """Base center-variable owner (reference: parameter_servers.py ·
+    ParameterServer / SocketParameterServer).
+
+    Lifecycle mirrors the reference: ``start()`` → workers pull/commit →
+    ``stop()`` → ``get_model()``. In-process there is no socket; ``start``/
+    ``stop`` manage optional transport endpoints and metrics.
+    """
+
+    def __init__(self, params: Any):
+        self.center = _to_host(params)
+        self.lock = threading.Lock()
+        self.num_updates = 0
+        self.staleness_log: List[int] = []
+        self._running = False
+
+    # -- lifecycle (reference: initialize/start/run/stop/get_model) --------
+
+    def start(self):
+        self._running = True
+
+    def stop(self):
+        self._running = False
+
+    def get_model(self):
+        with self.lock:
+            return jax.tree.map(np.copy, self.center)
+
+    # -- wire ops (reference: 'p' pull / 'c' commit) ------------------------
+
+    def pull(self):
+        with self.lock:
+            return jax.tree.map(np.copy, self.center)
+
+    def commit(self, delta: Any, worker: int = 0, worker_clock: int = 0):
+        raise NotImplementedError
+
+    def leave(self, worker: int):
+        """A worker is done (finished its partition or died). No-op for the
+        async servers; the synchronous server uses it to shrink its barrier
+        so surviving workers cannot deadlock."""
+
+class DeltaParameterServer(ParameterServer):
+    """``center += delta`` (reference: parameter_servers.py ·
+    DeltaParameterServer — serves DOWNPOUR / AEASGD / EAMSGD)."""
+
+    def commit(self, delta, worker: int = 0, worker_clock: int = 0):
+        with self.lock:
+            self.center = rules.downpour_commit(self.center, _to_host(delta))
+            self.num_updates += 1
+
+
+class ADAGParameterServer(ParameterServer):
+    """``center += delta / num_workers`` (reference: parameter_servers.py ·
+    ADAGParameterServer — normalized asynchronous accumulation)."""
+
+    def __init__(self, params, num_workers: int):
+        super().__init__(params)
+        self.num_workers = num_workers
+
+    def commit(self, delta, worker: int = 0, worker_clock: int = 0):
+        with self.lock:
+            self.center = rules.adag_commit(
+                self.center, _to_host(delta), self.num_workers
+            )
+            self.num_updates += 1
+
+
+class DynSGDParameterServer(ParameterServer):
+    """Staleness-aware commits (reference: parameter_servers.py ·
+    DynSGDParameterServer): the server keeps a global clock, workers pull a
+    (weights, clock) pair, and each commit is scaled by
+    ``1 / (server_clock - worker_clock + 1)``."""
+
+    def __init__(self, params):
+        super().__init__(params)
+        self.clock = 0
+
+    def pull_with_clock(self):
+        with self.lock:
+            return jax.tree.map(np.copy, self.center), self.clock
+
+    def commit(self, delta, worker: int = 0, worker_clock: int = 0):
+        with self.lock:
+            staleness = max(0, self.clock - worker_clock)
+            self.staleness_log.append(staleness)
+            self.center = rules.dynsgd_commit(
+                self.center, _to_host(delta), staleness
+            )
+            self.clock += 1
+            self.num_updates += 1
+
+
+class EASGDParameterServer(ParameterServer):
+    """Synchronous-round server (reference: parameter_servers.py ·
+    EASGDParameterServer): a round completes only when every worker has
+    committed its local weights; the center then moves by the summed elastic
+    forces and all workers observe the *pre-round* center.
+    """
+
+    def __init__(self, params, num_workers: int, rho: float = 5.0,
+                 elastic_lr: float = 0.1):
+        super().__init__(params)
+        self.num_workers = num_workers
+        self.alpha = elastic_lr
+        self._active = set(range(num_workers))
+        self._round_inputs: Dict[int, Any] = {}
+        self._round_center: Any = None
+        self._cond = threading.Condition(self.lock)
+        self._round = 0
+
+    def _round_complete_locked(self):
+        """Apply the round's center update and release waiters. Caller holds
+        the lock and has verified every *active* worker contributed."""
+        pre_center = jax.tree.map(np.copy, self.center)
+        self.center = rules.easgd_center_update(
+            self.center, list(self._round_inputs.values()), self.alpha
+        )
+        self.num_updates += 1
+        self._round_center = pre_center
+        self._round_inputs = {}
+        self._round += 1
+        self._cond.notify_all()
+
+    def commit_and_wait(self, worker_params, worker: int):
+        """Contribute to the current round; block until all *active* workers
+        have. Returns the center *as of the start of the round* (what the
+        elastic update is computed against).
+
+        The barrier counts only active workers: unequal partition sizes give
+        workers different round counts, so a finished worker calls
+        :meth:`leave` and the barrier shrinks instead of deadlocking (the
+        reference's synchronous server simply hung in that case —
+        SURVEY.md §5.3).
+        """
+        with self._cond:
+            my_round = self._round
+            self._round_inputs[worker] = _to_host(worker_params)
+            if len(self._round_inputs) >= len(self._active):
+                self._round_complete_locked()
+            else:
+                self._cond.wait_for(lambda: self._round > my_round)
+            return self._round_center
+
+    def leave(self, worker: int):
+        with self._cond:
+            self._active.discard(worker)
+            self._round_inputs.pop(worker, None)
+            if self._active and len(self._round_inputs) >= len(self._active):
+                self._round_complete_locked()
+            elif not self._active:
+                self._cond.notify_all()
+
+    def commit(self, delta, worker: int = 0, worker_clock: int = 0):
+        raise TypeError(
+            "EASGDParameterServer is synchronous; workers use commit_and_wait"
+        )

@@ -90,6 +90,13 @@ def get_metric(metric) -> LossFn:
         raise ValueError(f"Unknown metric '{metric}'. Known: {sorted(_METRICS)}") from None
 
 
+def resolve_metrics(metrics) -> list:
+    """Names/callables → ``[(name, fn), ...]`` pairs."""
+    return [
+        (m if isinstance(m, str) else m.__name__, get_metric(m)) for m in metrics
+    ]
+
+
 def get_optimizer(name, learning_rate: float = 0.01, **kwargs) -> optax.GradientTransformation:
     """Resolve a worker-side optimizer by Keras-style name.
 

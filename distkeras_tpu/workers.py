@@ -1,0 +1,341 @@
+"""Workers — per-device training step loops.
+
+Reference: distkeras/workers.py. There a worker is a pickled object run by
+Spark's ``mapPartitionsWithIndex`` on an executor: it deserializes the Keras
+model, compiles it, loops ``model.train_on_batch`` over its partition's rows,
+and (for the distributed algorithms) exchanges weights with the driver's
+parameter server over a socket every ``communication_window`` steps.
+
+TPU-native redesign:
+
+- The hot loop is a ``jit``-compiled ``value_and_grad`` + optax step
+  (reference · Worker.train's ``train_on_batch``), optionally a
+  ``lax.scan`` over a whole communication window so one XLA call covers
+  W steps with zero host round-trips in between.
+- Partition rows are batched into contiguous arrays once (static shapes —
+  the partial trailing batch is dropped, as XLA recompiles per shape).
+- The socket client (reference · NetworkWorker.connect/pull/push) becomes a
+  direct handle to a :class:`~distkeras_tpu.parameter_servers.ParameterServer`
+  — in-process and lock-protected on one host, or proxied over the
+  :mod:`distkeras_tpu.networking` transport between hosts.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from distkeras_tpu.ops import rules
+from distkeras_tpu.utils.history import History
+from distkeras_tpu.utils.losses import get_loss, get_optimizer, resolve_metrics
+
+
+def make_train_step(
+    apply_fn: Callable,
+    loss_fn: Callable,
+    optimizer: optax.GradientTransformation,
+    metrics: Sequence[Tuple[str, Callable]] = (),
+):
+    """Build the jitted single-batch training step.
+
+    Reference: distkeras/workers.py · Worker.train's ``model.train_on_batch``
+    — here one fused XLA program: forward, loss, backward, optimizer update.
+    """
+
+    @jax.jit
+    def step(params, opt_state, x, y):
+        def objective(p):
+            logits = apply_fn(p, x)
+            return loss_fn(logits, y), logits
+
+        (loss, logits), grads = jax.value_and_grad(objective, has_aux=True)(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        out = {"loss": loss}
+        for name, fn in metrics:
+            out[name] = fn(logits, y)
+        return params, opt_state, out
+
+    return step
+
+
+def make_window_step(
+    apply_fn: Callable,
+    loss_fn: Callable,
+    optimizer: optax.GradientTransformation,
+    metrics: Sequence[Tuple[str, Callable]] = (),
+):
+    """Build a jitted step that runs a whole communication window of batches
+    via ``lax.scan`` — one device dispatch per window instead of per batch.
+
+    ``xs``: stacked window batches ``(x: [W, B, ...], y: [W, B, ...])``.
+    Returns per-step metric arrays of shape ``[W]``.
+    """
+
+    @jax.jit
+    def window(params, opt_state, xs, ys):
+        def body(carry, batch):
+            p, s = carry
+            x, y = batch
+
+            def objective(pp):
+                logits = apply_fn(pp, x)
+                return loss_fn(logits, y), logits
+
+            (loss, logits), grads = jax.value_and_grad(objective, has_aux=True)(p)
+            updates, s = optimizer.update(grads, s, p)
+            p = optax.apply_updates(p, updates)
+            out = {"loss": loss}
+            for name, fn in metrics:
+                out[name] = fn(logits, y)
+            return (p, s), out
+
+        (params, opt_state), ms = jax.lax.scan(body, (params, opt_state), (xs, ys))
+        return params, opt_state, ms
+
+    return window
+
+
+def batch_partition(
+    partition: Dict[str, np.ndarray],
+    features_col: str,
+    label_col: str,
+    batch_size: int,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Partition columns → stacked full batches ``[N_batches, B, ...]``.
+
+    The trailing partial batch is dropped to keep shapes static under jit
+    (the reference's Keras path tolerated ragged final batches; on TPU a
+    ragged batch means an XLA recompile per partition, which costs far more
+    than the <1 batch of data).
+    """
+    x = partition[features_col]
+    y = partition[label_col]
+    n = (len(x) // batch_size) * batch_size
+    if n == 0:
+        raise ValueError(
+            f"partition with {len(x)} rows is smaller than batch_size={batch_size}"
+        )
+    xb = x[:n].reshape((-1, batch_size) + x.shape[1:])
+    yb = y[:n].reshape((-1, batch_size) + y.shape[1:])
+    return xb, yb
+
+
+class Worker:
+    """Shared per-worker machinery (reference: distkeras/workers.py · Worker).
+
+    Holds the model apply function, resolved loss/metrics/optimizer, and
+    batching configuration; subclasses implement ``train``.
+    """
+
+    def __init__(
+        self,
+        module,
+        params,
+        optimizer="sgd",
+        learning_rate: float = 0.01,
+        loss="categorical_crossentropy",
+        metrics: Sequence[str] = ("accuracy",),
+        features_col: str = "features",
+        label_col: str = "label",
+        batch_size: int = 32,
+        num_epoch: int = 1,
+    ):
+        self.module = module
+        self.params = params
+        self.optimizer = get_optimizer(optimizer, learning_rate)
+        self.loss_fn = get_loss(loss)
+        self.metrics = resolve_metrics(metrics)
+        self.features_col = features_col
+        self.label_col = label_col
+        self.batch_size = batch_size
+        self.num_epoch = num_epoch
+
+    def set_compiled(self, step, window_step):
+        """Install shared jit-compiled step functions (built once by the
+        trainer) so N workers don't pay N redundant XLA compiles."""
+        self.step = step
+        self.window_step = window_step
+
+    def prepare(self):
+        """Build the jitted step (reference · Worker.prepare_model:
+        deserialize + compile) unless shared ones were installed."""
+        if getattr(self, "step", None) is None:
+            self.step = make_train_step(
+                self.module.apply, self.loss_fn, self.optimizer, self.metrics
+            )
+            self.window_step = make_window_step(
+                self.module.apply, self.loss_fn, self.optimizer, self.metrics
+            )
+        self.opt_state = self.optimizer.init(self.params)
+
+    def batches(self, partition) -> Tuple[np.ndarray, np.ndarray]:
+        return batch_partition(
+            partition, self.features_col, self.label_col, self.batch_size
+        )
+
+
+class SequentialWorker(Worker):
+    """Plain local training loop over one partition (reference:
+    distkeras/workers.py · SequentialWorker, used by SingleTrainer /
+    EnsembleTrainer / AveragingTrainer).
+
+    Runs each epoch as one ``lax.scan`` over all full batches — the entire
+    epoch is a single XLA dispatch.
+    """
+
+    def train(self, index: int, partition) -> Tuple[object, History]:
+        self.prepare()
+        xb, yb = self.batches(partition)
+        params, opt_state = self.params, self.opt_state
+        history: History = []
+        for _ in range(self.num_epoch):
+            params, opt_state, ms = self.window_step(
+                params, opt_state, jnp.asarray(xb), jnp.asarray(yb)
+            )
+            ms = {k: np.asarray(v) for k, v in ms.items()}
+            for t in range(len(xb)):
+                history.append({k: float(v[t]) for k, v in ms.items()})
+        self.params = params
+        return params, history
+
+
+class WindowedWorker(Worker):
+    """Base for the parameter-server algorithms: run ``communication_window``
+    local steps per round, then exchange with the center
+    (reference: distkeras/workers.py · NetworkWorker and subclasses).
+
+    Subclasses override :meth:`on_round` — called after each window with the
+    parameter server handle — and may use ``self.last_pulled``.
+    """
+
+    def __init__(self, *args, communication_window: int = 5, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.communication_window = communication_window
+        self.last_pulled = None
+        self.worker_clock = 0
+
+    # -- center exchange hooks ---------------------------------------------
+
+    def on_start(self, index: int, ps):
+        """Initial pull (reference · NetworkWorker: connect + first pull)."""
+        self.params = ps.pull()
+        self.last_pulled = self.params
+
+    def on_round(self, index: int, ps):
+        raise NotImplementedError
+
+    def train(self, index: int, partition, ps) -> Tuple[object, History]:
+        self.prepare()
+        self.on_start(index, ps)
+        xb, yb = self.batches(partition)
+        n_batches = len(xb)
+        W = self.communication_window
+        history: History = []
+        for _ in range(self.num_epoch):
+            start = 0
+            while start < n_batches:
+                stop = min(start + W, n_batches)
+                if stop - start == W:
+                    # full window: one fused scan dispatch
+                    params, opt_state, ms = self.window_step(
+                        self.params, self.opt_state,
+                        jnp.asarray(xb[start:stop]), jnp.asarray(yb[start:stop]),
+                    )
+                    self.params, self.opt_state = params, opt_state
+                    ms = {k: np.asarray(v) for k, v in ms.items()}
+                    for t in range(stop - start):
+                        history.append({k: float(v[t]) for k, v in ms.items()})
+                else:
+                    for b in range(start, stop):
+                        self.params, self.opt_state, m = self.step(
+                            self.params, self.opt_state,
+                            jnp.asarray(xb[b]), jnp.asarray(yb[b]),
+                        )
+                        history.append({k: float(v) for k, v in m.items()})
+                self.on_round(index, ps)
+                start = stop
+        return self.params, history
+
+
+class DOWNPOURWorker(WindowedWorker):
+    """Push accumulated weight delta, then pull fresh center
+    (reference: distkeras/workers.py · DOWNPOURWorker)."""
+
+    def on_round(self, index: int, ps):
+        delta = rules.downpour_delta(self.params, self.last_pulled)
+        ps.commit(delta, worker=index, worker_clock=self.worker_clock)
+        self.worker_clock += 1
+        # note: worker optimizer state persists across pulls, matching the
+        # reference where set_weights() does not reset the Keras optimizer
+        self.params = ps.pull()
+        self.last_pulled = self.params
+
+
+class ADAGWorker(DOWNPOURWorker):
+    """Identical client behavior to DOWNPOUR; the normalization happens on
+    the ADAG parameter server (reference: distkeras/workers.py · ADAGWorker)."""
+
+
+class DynSGDWorker(WindowedWorker):
+    """Delta push tagged with the worker's clock at last pull
+    (reference: distkeras/workers.py · DynSGDWorker)."""
+
+    def on_start(self, index: int, ps):
+        self.params, self.worker_clock = ps.pull_with_clock()
+        self.last_pulled = self.params
+
+    def on_round(self, index: int, ps):
+        delta = rules.downpour_delta(self.params, self.last_pulled)
+        ps.commit(delta, worker=index, worker_clock=self.worker_clock)
+        self.params, self.worker_clock = ps.pull_with_clock()
+        self.last_pulled = self.params
+
+
+class AEASGDWorker(WindowedWorker):
+    """Asynchronous elastic averaging: each round pulls the center, applies
+    the elastic force locally, and pushes the same force to the server
+    (reference: distkeras/workers.py · AEASGDWorker).
+    """
+
+    def __init__(self, *args, rho: float = 5.0, elastic_lr: float = 0.1, **kwargs):
+        super().__init__(*args, **kwargs)
+        # the paper's alpha = eta * rho; the reference exposes it through its
+        # (rho, learning_rate) ctor args — we take the product directly
+        self.alpha = elastic_lr
+        self.rho = rho
+
+    def on_round(self, index: int, ps):
+        center = ps.pull()
+        diff = rules.elastic_difference(self.alpha, self.params, center)
+        self.params = rules.tree_sub(self.params, diff)
+        ps.commit(diff, worker=index, worker_clock=self.worker_clock)
+        self.worker_clock += 1
+
+
+class EAMSGDWorker(AEASGDWorker):
+    """AEASGD with Nesterov-style momentum on the local steps (reference:
+    distkeras/workers.py · EAMSGDWorker). The momentum lives in the worker's
+    optax optimizer (sgd+momentum+nesterov); the elastic exchange is
+    identical to AEASGD."""
+
+
+class EASGDWorker(WindowedWorker):
+    """Synchronous EASGD round: push local weights, wait for the round
+    barrier, then apply the elastic update against the round's center
+    (reference: distkeras/workers.py · EASGDWorker with the synchronous
+    EASGDParameterServer)."""
+
+    def __init__(self, *args, rho: float = 5.0, elastic_lr: float = 0.1, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.alpha = elastic_lr
+        self.rho = rho
+
+    def on_round(self, index: int, ps):
+        # commit blocks until every worker has contributed to the round
+        center = ps.commit_and_wait(self.params, worker=index)
+        self.params = rules.easgd_worker_update(self.params, center, self.alpha)
