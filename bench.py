@@ -1,0 +1,82 @@
+"""Headline benchmark: CIFAR-10-shaped CNN training throughput per chip.
+
+Prints ONE JSON line:
+``{"metric": ..., "value": N, "unit": "samples/sec/chip", "vs_baseline": N}``
+
+Workload: BASELINE.md config 3 — the CIFAR-10 CNN training step (forward +
+backward + SGD update, bfloat16 compute) on synthetic CIFAR-shaped data
+(zero-egress environment; the arithmetic is identical to real data).
+
+Baseline: the reference (dist-keras) publishes no throughput numbers
+(BASELINE.json "published": {}). BASELINE.md's north star is ">=5x
+single-GPU throughput"; we anchor the comparison at 2000 samples/sec,
+a representative single-GPU figure for a CIFAR-10 CNN of this size in the
+reference's era, so vs_baseline = samples_per_sec / 2000 and the >=5x goal
+reads as vs_baseline >= 5.
+"""
+
+import json
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+BASELINE_SAMPLES_PER_SEC = 2000.0
+
+
+def main():
+    import optax
+
+    from distkeras_tpu.models import get_model
+    from distkeras_tpu.utils.losses import get_loss
+    from distkeras_tpu.workers import make_window_step
+
+    batch = 1024
+    steps_per_call = 10
+    calls = 5
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(
+        rng.normal(size=(steps_per_call, batch, 32, 32, 3)), jnp.bfloat16
+    )
+    y = jnp.asarray(
+        np.eye(10, dtype=np.float32)[
+            rng.integers(0, 10, size=(steps_per_call, batch))
+        ]
+    )
+
+    model = get_model("cifar_cnn")
+    params = model.init(jax.random.PRNGKey(0), x[0, :1].astype(jnp.float32))
+    optimizer = optax.sgd(0.05, momentum=0.9)
+    opt_state = optimizer.init(params)
+    step = make_window_step(
+        model.apply, get_loss("categorical_crossentropy"), optimizer
+    )
+
+    # warmup / compile (fetch a scalar to guarantee full completion — on
+    # some PJRT transports block_until_ready alone returns early)
+    params, opt_state, ms = step(params, opt_state, x, y)
+    float(np.asarray(ms["loss"])[-1])
+
+    t0 = time.perf_counter()
+    for _ in range(calls):
+        params, opt_state, ms = step(params, opt_state, x, y)
+    final_loss = float(np.asarray(ms["loss"])[-1])
+    dt = time.perf_counter() - t0
+    assert np.isfinite(final_loss)
+
+    n_chips = max(1, len(jax.devices()))
+    samples = calls * steps_per_call * batch
+    sps_per_chip = samples / dt / n_chips
+    print(json.dumps({
+        "metric": "cifar10_cnn_train_samples_per_sec_per_chip",
+        "value": round(sps_per_chip, 1),
+        "unit": "samples/sec/chip",
+        "vs_baseline": round(sps_per_chip / BASELINE_SAMPLES_PER_SEC, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

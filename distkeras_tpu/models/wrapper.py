@@ -9,10 +9,19 @@ params pytree, with a cached ``jit``-compiled batched apply.
 
 from __future__ import annotations
 
+import functools
 from typing import Any
 
 import jax
 import numpy as np
+
+
+@functools.lru_cache(maxsize=128)
+def _jitted_apply(module):
+    """One jitted apply per module value (flax modules hash by config), so
+    every Model over the same architecture shares one compile cache instead
+    of recompiling per instance (ensembles, replace_params sweeps, …)."""
+    return jax.jit(module.apply)
 
 
 class Model:
@@ -21,7 +30,7 @@ class Model:
     def __init__(self, module, params):
         self.module = module
         self.params = params
-        self.apply_jit = jax.jit(lambda p, x: module.apply(p, x))
+        self.apply_jit = _jitted_apply(module)
 
     def predict(self, x) -> np.ndarray:
         """Batched forward pass → host numpy (the reference's

@@ -80,11 +80,17 @@ def ring_attention(
         vc = jax.lax.ppermute(vc, axis_name, perm)
         return (o_new, m_new, l_new, kc, vc), None
 
-    # The accumulators are device-varying (each shard computes its own); mark
-    # them as varying over the ring axis or scan rejects the carry types.
-    o0 = jax.lax.pcast(jnp.zeros((B, T_local, H, hd), jnp.float32), axis_name, to='varying')
-    m0 = jax.lax.pcast(jnp.full((B, H, T_local), _NEG_INF, jnp.float32), axis_name, to='varying')
-    l0 = jax.lax.pcast(jnp.zeros((B, H, T_local), jnp.float32), axis_name, to='varying')
+    # The accumulators are device-varying (each shard computes its own). They
+    # must carry the same varying-manual-axes type as q — which may vary over
+    # more mesh axes than the ring axis (e.g. batch over 'dp' too) — or scan
+    # rejects the carry types. pcast the constants to q's full vma set (a
+    # data-derived zero would let one non-finite element of q NaN-poison
+    # every accumulator).
+    vma = tuple(sorted(getattr(jax.typeof(q), "vma", None) or (axis_name,)))
+    cast = lambda a: jax.lax.pcast(a, vma, to="varying")  # noqa: E731
+    o0 = cast(jnp.zeros((B, T_local, H, hd), jnp.float32))
+    m0 = cast(jnp.full((B, H, T_local), _NEG_INF, jnp.float32))
+    l0 = cast(jnp.zeros((B, H, T_local), jnp.float32))
     (o, _, l, _, _), _ = jax.lax.scan(
         step, (o0, m0, l0, k, v), jnp.arange(num_shards)
     )

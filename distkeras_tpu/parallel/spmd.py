@@ -1,0 +1,123 @@
+"""SPMD training steps over multi-axis device meshes.
+
+This is the multi-chip training path: one program text, sharded over a
+named mesh with XLA collectives over ICI — the TPU-native answer to the
+reference's driver/executor/socket topology (SURVEY.md §5.8).
+
+Current axes:
+
+- ``dp`` — batch sharding; gradient reduction rides the autodiff-inserted
+  psum (the transpose of broadcasting replicated params over ``dp``).
+- ``sp`` — sequence sharding for the language-model step: ring attention
+  (:mod:`distkeras_tpu.ops.ring_attention`) plus a ``ppermute`` to fetch
+  each shard's next-token target across the shard boundary.
+
+The classifier step (images/labels) uses ``dp`` only and serves any model
+in the zoo; the LM step adds ``sp`` and serves :class:`TransformerLM` built
+with ``attention='ring'``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from distkeras_tpu.ops import rules
+
+
+def make_dp_train_step(apply_fn, loss_fn, optimizer, mesh: Mesh,
+                       dp_axis: str = "dp"):
+    """Jitted synchronous data-parallel step: batch sharded over ``dp_axis``,
+    params replicated, global-mean gradient via the autodiff psum.
+
+    Returns ``step(params, opt_state, x, y) -> (params, opt_state, loss)``.
+    """
+
+    def device_step(params, opt_state, x, y):
+        def objective(p):
+            return loss_fn(apply_fn(p, x), y)
+
+        loss, grads = jax.value_and_grad(objective)(params)
+        # replicated params + sharded batch → backward pass already psum'd
+        # grads over dp; divide by axis size for the global mean.
+        n = jax.lax.psum(1, dp_axis)
+        grads = rules.tree_scale(grads, 1.0 / n)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, jax.lax.pmean(loss, dp_axis)
+
+    return jax.jit(
+        shard_map(
+            device_step,
+            mesh=mesh,
+            in_specs=(P(), P(), P(dp_axis), P(dp_axis)),
+            out_specs=(P(), P(), P()),
+        )
+    )
+
+
+def make_lm_train_step(model, optimizer, mesh: Mesh,
+                       dp_axis: str = "dp", sp_axis: str = "sp"):
+    """Jitted language-model training step sharded over data x sequence.
+
+    ``tokens`` is ``[B, T]`` with B sharded over ``dp_axis`` and T over
+    ``sp_axis``. The model must be a :class:`TransformerLM` constructed with
+    ``attention='ring'`` and ``seq_axis=sp_axis`` so attention is exact over
+    the full sequence while each device holds only ``T/sp`` of it.
+
+    Next-token targets cross the shard boundary: each shard's last position
+    is supervised by the *next* shard's first token, fetched with one
+    ``ppermute``; the final global position is masked out.
+
+    Returns ``step(params, opt_state, tokens) -> (params, opt_state, loss)``
+    where loss is the global mean next-token cross-entropy.
+    """
+    sp_size = int(np.prod([s for a, s in zip(mesh.axis_names, mesh.devices.shape)
+                           if a == sp_axis] or [1]))
+
+    def device_step(params, opt_state, tokens):
+        B_l, T_l = tokens.shape
+        my_sp = jax.lax.axis_index(sp_axis)
+        # neighbor's first column supervises my last position
+        perm = [(j, (j - 1) % sp_size) for j in range(sp_size)]
+        next_first = jax.lax.ppermute(tokens[:, :1], sp_axis, perm)
+        targets = jnp.concatenate([tokens[:, 1:], next_first], axis=1)
+        # mask the last global position (its target wrapped around the ring)
+        local_pos = my_sp * T_l + jnp.arange(T_l)
+        total_T = T_l * sp_size
+        mask = (local_pos < total_T - 1).astype(jnp.float32)[None, :]
+
+        def objective(p):
+            logits = model.apply(p, tokens)
+            token_loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits, targets
+            )
+            local_sum = jnp.sum(token_loss * mask)
+            # tie the count to token_loss's vma (varying over dp AND sp) so
+            # the two-axis psum below typechecks
+            local_cnt = jnp.sum((token_loss * 0.0 + 1.0) * mask)
+            global_cnt = jax.lax.psum(local_cnt, (dp_axis, sp_axis))
+            # objective sums to the global mean across all shards: the
+            # autodiff psum over (dp, sp) then yields the exact global grad
+            return local_sum / global_cnt
+
+        local_obj, grads = jax.value_and_grad(objective)(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        loss = jax.lax.psum(local_obj, (dp_axis, sp_axis))
+        return params, opt_state, loss
+
+    return jax.jit(
+        shard_map(
+            device_step,
+            mesh=mesh,
+            in_specs=(P(), P(), P(dp_axis, sp_axis)),
+            out_specs=(P(), P(), P()),
+        )
+    )

@@ -136,10 +136,11 @@ class EASGDParameterServer(ParameterServer):
     """
 
     def __init__(self, params, num_workers: int, rho: float = 5.0,
-                 elastic_lr: float = 0.1):
+                 elastic_lr: float = 0.01):
         super().__init__(params)
         self.num_workers = num_workers
-        self.alpha = elastic_lr
+        self.rho = rho
+        self.alpha = elastic_lr * rho  # paper: alpha = eta * rho
         self._active = set(range(num_workers))
         self._round_inputs: Dict[int, Any] = {}
         self._round_center: Any = None

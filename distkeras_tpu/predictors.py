@@ -41,24 +41,26 @@ class ModelPredictor(Predictor):
         self.batch_size = batch_size
 
     def _predict_array(self, x: np.ndarray) -> np.ndarray:
-        """Fixed-shape batched apply: full batches + one padded tail batch,
-        so at most two XLA programs exist for any input size."""
+        """Fixed-shape batched apply: every XLA call sees exactly
+        ``batch_size`` rows (short/tail batches are zero-padded and sliced),
+        so ONE compiled program serves any partition size — including empty
+        partitions, which still produce a correctly-shaped ``[0, ...]``
+        output."""
         n = len(x)
-        B = min(self.batch_size, n) if n else 0
+        B = self.batch_size
+        row_shape = x.shape[1:]
         outs = []
-        full = (n // B) * B if B else 0
-        for s in range(0, full, B):
-            outs.append(np.asarray(
-                self.model.apply_jit(self.model.params, jnp.asarray(x[s:s + B]))
-            ))
-        if n > full:  # padded tail
-            tail = x[full:]
-            pad = np.concatenate(
-                [tail, np.repeat(tail[-1:], B - len(tail), axis=0)], axis=0
+        for s in range(0, max(n, 1), B):
+            chunk = x[s : s + B]
+            if len(chunk) < B:
+                pad = np.zeros((B - len(chunk),) + row_shape, dtype=x.dtype)
+                chunk = np.concatenate([chunk, pad], axis=0) if len(chunk) else pad
+            out = np.asarray(
+                self.model.apply_jit(self.model.params, jnp.asarray(chunk))
             )
-            out = np.asarray(self.model.apply_jit(self.model.params, jnp.asarray(pad)))
-            outs.append(out[: len(tail)])
-        return np.concatenate(outs, axis=0) if outs else np.zeros((0,))
+            outs.append(out[: min(B, n - s)] if n - s < B else out)
+        result = np.concatenate(outs, axis=0)
+        return result[:n]
 
     def predict(self, dataset: PartitionedDataset) -> PartitionedDataset:
         return dataset.with_column(

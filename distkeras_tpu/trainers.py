@@ -168,14 +168,17 @@ class EnsembleTrainer(Trainer):
         dataset = dataset.repartition(self.num_models)
         models: List[Model] = []
         self.executor_histories = []
+        workers = []
         for i in range(self.num_models):
             x = dataset.partition(i)[self.features_col][:1]
             params = self.model.init(
                 jax.random.PRNGKey(self.seed + i), jnp.asarray(x)
             )
-            worker = workers_mod.SequentialWorker(
+            workers.append(workers_mod.SequentialWorker(
                 self.model, params, **self.worker_kwargs()
-            )
+            ))
+        workers_mod.share_compiled(workers)
+        for i, worker in enumerate(workers):
             params, history = worker.train(i, dataset.partition(i))
             models.append(Model(self.model, params))
             self.executor_histories.append(history)
@@ -199,10 +202,14 @@ class AveragingTrainer(Trainer):
         self.ensure_params(dataset)
         trained = []
         self.executor_histories = []
-        for i in range(self.num_workers):
-            worker = workers_mod.SequentialWorker(
+        workers = [
+            workers_mod.SequentialWorker(
                 self.model, self.params, **self.worker_kwargs()
             )
+            for _ in range(self.num_workers)
+        ]
+        workers_mod.share_compiled(workers)
+        for i, worker in enumerate(workers):
             params, history = worker.train(i, dataset.partition(i))
             trained.append(params)
             self.executor_histories.append(history)
@@ -263,24 +270,8 @@ class DistributedTrainer(Trainer):
         results: List[Optional[History]] = [None] * n_parts
         errors: List[BaseException] = []
 
-        # Resolve the optimizer once and share one pair of jit-compiled step
-        # functions across all workers — their configs are identical, so
-        # per-worker closures would pay num_workers x redundant XLA compiles.
         workers = [self.allocate_worker(i) for i in range(n_parts)]
-        shared_opt = workers[0].optimizer
-        shared_steps = (
-            workers_mod.make_train_step(
-                self.model.apply, workers[0].loss_fn, shared_opt,
-                workers[0].metrics,
-            ),
-            workers_mod.make_window_step(
-                self.model.apply, workers[0].loss_fn, shared_opt,
-                workers[0].metrics,
-            ),
-        )
-        for w in workers:
-            w.optimizer = shared_opt
-            w.set_compiled(*shared_steps)
+        workers_mod.share_compiled(workers)
 
         def run(i: int):
             try:
@@ -353,7 +344,7 @@ class AEASGD(AsynchronousDistributedTrainer):
 
     WORKER_CLS = workers_mod.AEASGDWorker
 
-    def __init__(self, *args, rho: float = 5.0, elastic_lr: float = 0.1,
+    def __init__(self, *args, rho: float = 5.0, elastic_lr: float = 0.01,
                  **kwargs):
         super().__init__(*args, **kwargs)
         self.rho = rho
@@ -393,7 +384,7 @@ class EASGD(SynchronousDistributedTrainer):
 
     WORKER_CLS = workers_mod.EASGDWorker
 
-    def __init__(self, *args, rho: float = 5.0, elastic_lr: float = 0.1,
+    def __init__(self, *args, rho: float = 5.0, elastic_lr: float = 0.01,
                  **kwargs):
         super().__init__(*args, **kwargs)
         self.rho = rho

@@ -125,6 +125,18 @@ def batch_partition(
     return xb, yb
 
 
+def share_compiled(workers: List["Worker"]):
+    """Give every worker one shared optimizer + one pair of jitted steps
+    (their configs are identical), avoiding num_workers x redundant XLA
+    compiles of the same program."""
+    w0 = workers[0]
+    step = make_train_step(w0.module.apply, w0.loss_fn, w0.optimizer, w0.metrics)
+    window = make_window_step(w0.module.apply, w0.loss_fn, w0.optimizer, w0.metrics)
+    for w in workers:
+        w.optimizer = w0.optimizer
+        w.set_compiled(step, window)
+
+
 class Worker:
     """Shared per-worker machinery (reference: distkeras/workers.py · Worker).
 
@@ -302,12 +314,12 @@ class AEASGDWorker(WindowedWorker):
     (reference: distkeras/workers.py · AEASGDWorker).
     """
 
-    def __init__(self, *args, rho: float = 5.0, elastic_lr: float = 0.1, **kwargs):
+    def __init__(self, *args, rho: float = 5.0, elastic_lr: float = 0.01, **kwargs):
         super().__init__(*args, **kwargs)
-        # the paper's alpha = eta * rho; the reference exposes it through its
-        # (rho, learning_rate) ctor args — we take the product directly
-        self.alpha = elastic_lr
+        # the paper's elastic coefficient alpha = eta * rho (reference ctor
+        # args rho + learning_rate); both knobs are live
         self.rho = rho
+        self.alpha = elastic_lr * rho
 
     def on_round(self, index: int, ps):
         center = ps.pull()
@@ -330,10 +342,10 @@ class EASGDWorker(WindowedWorker):
     (reference: distkeras/workers.py · EASGDWorker with the synchronous
     EASGDParameterServer)."""
 
-    def __init__(self, *args, rho: float = 5.0, elastic_lr: float = 0.1, **kwargs):
+    def __init__(self, *args, rho: float = 5.0, elastic_lr: float = 0.01, **kwargs):
         super().__init__(*args, **kwargs)
-        self.alpha = elastic_lr
         self.rho = rho
+        self.alpha = elastic_lr * rho
 
     def on_round(self, index: int, ps):
         # commit blocks until every worker has contributed to the round

@@ -210,3 +210,38 @@ def test_eamsgd_momentum_wired():
     t = EAMSGD(get_model("mlp", **MODEL_KW), momentum=0.5, **TRAIN_KW)
     import optax
     assert isinstance(t.worker_optimizer, optax.GradientTransformation)
+
+
+def test_predictor_handles_empty_and_tiny_partitions():
+    """3 rows over 4 partitions leaves one empty; predictions must still
+    come back with the right shape through one fixed-shape XLA program."""
+    rng = np.random.default_rng(0)
+    ds = PartitionedDataset.from_arrays(
+        {"features": rng.normal(size=(3, 16)).astype(np.float32)},
+        num_partitions=4,
+    )
+    model_def = get_model("mlp", **MODEL_KW)
+    import jax
+    params = model_def.init(jax.random.PRNGKey(0), jnp.zeros((1, 16)))
+    from distkeras_tpu import Model
+    out = ModelPredictor(Model(model_def, params), batch_size=8).predict(ds)
+    assert out.column("prediction").shape == (3, 4)
+
+
+def test_easgd_rho_knob_is_live():
+    """rho=0 kills the elastic force entirely: the center never moves."""
+    ds = synthetic_dataset(n=256, partitions=2)
+    trainer = EASGD(
+        get_model("mlp", **MODEL_KW),
+        num_workers=2,
+        communication_window=2,
+        rho=0.0,
+        elastic_lr=0.05,
+        **dict(TRAIN_KW, num_epoch=1),
+    )
+    trainer.train(ds)
+    import jax
+    init = trainer.ensure_params(ds)
+    final = trainer.parameter_server.get_model()
+    for a, b in zip(jax.tree.leaves(init), jax.tree.leaves(final)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
