@@ -1,0 +1,104 @@
+"""Step-level checkpoint / resume.
+
+The reference has NO checkpointing (SURVEY.md §5.4): the trained model
+exists only in driver memory until the user calls Keras ``save()``; a
+crashed run restarts from scratch. This module is the capability win the
+survey calls for: orbax-backed save/restore of the full training state
+(params + optimizer state + step counter + data-order seed), so any trainer
+can resume mid-run deterministically.
+
+Usage::
+
+    ckpt = Checkpointer(dir, every_steps=100, max_to_keep=3)
+    trainer = SingleTrainer(model, checkpointer=ckpt, ...)
+    trainer.train(ds)          # writes checkpoints as it goes
+    # after a crash:
+    trainer2 = SingleTrainer(model, checkpointer=Checkpointer(dir), ...)
+    trainer2.train(ds)         # resumes from the latest step
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+
+class Checkpointer:
+    """Thin wrapper over an orbax ``CheckpointManager``.
+
+    State layout: one pytree ``{"params": ..., "opt_state": ..., "step": n,
+    "seed": s}`` per step directory.
+    """
+
+    def __init__(self, directory: str, every_steps: int = 100,
+                 max_to_keep: int = 3):
+        self.directory = os.path.abspath(directory)
+        self.every_steps = max(1, int(every_steps))
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True
+            ),
+        )
+
+    # -- write --------------------------------------------------------------
+
+    def maybe_save(self, step: int, params: Any, opt_state: Any = None,
+                   extra: Optional[dict] = None, force: bool = False):
+        """Save if ``step`` hits the cadence (or ``force``). A step that was
+        already saved is skipped (orbax raises StepAlreadyExistsError on
+        re-save — e.g. a forced final save landing on a cadence step)."""
+        if not force and step % self.every_steps != 0:
+            return False
+        if step in self._mgr.all_steps():
+            return False
+        state = {
+            "params": jax.tree.map(np.asarray, params),
+            "opt_state": jax.tree.map(np.asarray, opt_state)
+            if opt_state is not None else {},
+            "extra": dict(extra or {}),
+        }
+        self._mgr.save(step, args=ocp.args.StandardSave(state))
+        return True
+
+    def wait(self):
+        self._mgr.wait_until_finished()
+
+    # -- read ---------------------------------------------------------------
+
+    @property
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def restore(self, step: Optional[int] = None, like: Optional[dict] = None):
+        """Restore ``(step, state)``; ``state`` is the dict saved above.
+        Returns ``(None, None)`` when no checkpoint exists.
+
+        ``like`` is a template with the target structure — required to
+        reconstruct non-dict pytree nodes (optax NamedTuple states, tuples);
+        without it the state comes back as raw nested containers.
+        """
+        step = step if step is not None else self.latest_step
+        if step is None:
+            return None, None
+        if like is not None:
+            template = {
+                "params": jax.tree.map(np.asarray, like.get("params")),
+                "opt_state": jax.tree.map(np.asarray, like.get("opt_state"))
+                if like.get("opt_state") is not None else {},
+                "extra": dict(like.get("extra") or {}),
+            }
+            state = self._mgr.restore(
+                step, args=ocp.args.StandardRestore(template)
+            )
+        else:
+            state = self._mgr.restore(step)
+        return step, state
+
+    def close(self):
+        self._mgr.wait_until_finished()
+        self._mgr.close()

@@ -1,0 +1,320 @@
+"""Networking — the multi-host parameter-server transport.
+
+Reference: distkeras/networking.py — ``determine_host_address``,
+``connect``, ``send_data``/``recv_data`` (pickle + fixed-size length header
+over TCP). That module was the reference's entire communication backend.
+
+TPU-native role: *intra*-host and *intra*-slice communication is XLA
+collectives over ICI (:mod:`distkeras_tpu.parallel`) and never touches this
+module. This transport exists for the asynchronous algorithms *across*
+hosts (DCN): each host runs its workers against a
+:class:`RemoteParameterServer` proxy speaking a framed msgpack protocol to
+a :class:`ParameterServerService` wrapping the real center variable on host
+0 — async-over-DCN, sync-over-ICI (SURVEY.md §5.8).
+
+Differences from the reference, by design:
+
+- **msgpack, not pickle** — no arbitrary code execution on either end of
+  the socket (the reference unpickled whatever the peer sent).
+- **native data plane** — framing and full-buffer send/recv loops run in C
+  (``native/dk_transport.c``) via ctypes, which releases the GIL for the
+  whole syscall loop; Python fallback if no compiler is available.
+- one handler thread per connection, as upstream, but commits delegate to
+  the lock-protected :class:`ParameterServer` objects rather than mutating
+  shared state inline.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import socket
+import struct
+import threading
+from typing import Any, Optional
+
+import jax
+import numpy as np
+from flax import serialization as flax_serialization
+
+
+def _to_host(tree):
+    """Device/jax arrays → numpy (msgpack can't serialize jax Arrays)."""
+    return jax.tree.map(np.asarray, tree)
+
+# ---------------------------------------------------------------------------
+# Native data plane (ctypes; pure-Python fallback)
+# ---------------------------------------------------------------------------
+
+_NATIVE_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "native", "libdk_transport.so",
+)
+_native = None
+
+
+def _load_native():
+    global _native
+    if _native is not None:
+        return _native
+    if not os.path.exists(_NATIVE_PATH):
+        try:
+            import sys
+
+            sys.path.insert(0, os.path.dirname(os.path.dirname(_NATIVE_PATH)))
+            from native.build import build
+
+            build(quiet=True)
+        except Exception:
+            _native = False
+            return False
+    try:
+        lib = ctypes.CDLL(_NATIVE_PATH)
+        lib.dk_send_frame.argtypes = [
+            ctypes.c_int, ctypes.c_char_p, ctypes.c_uint64
+        ]
+        lib.dk_send_frame.restype = ctypes.c_int
+        lib.dk_recv_frame_size.argtypes = [ctypes.c_int]
+        lib.dk_recv_frame_size.restype = ctypes.c_int64
+        lib.dk_recv_exact.argtypes = [
+            ctypes.c_int, ctypes.c_char_p, ctypes.c_uint64
+        ]
+        lib.dk_recv_exact.restype = ctypes.c_int
+        _native = lib
+    except OSError:
+        _native = False
+    return _native
+
+
+def native_transport_active() -> bool:
+    return bool(_load_native())
+
+
+# ---------------------------------------------------------------------------
+# Framing (reference: send_data / recv_data)
+# ---------------------------------------------------------------------------
+
+def send_frame(sock: socket.socket, payload: bytes):
+    lib = _load_native()
+    if lib:
+        rc = lib.dk_send_frame(sock.fileno(), payload, len(payload))
+        if rc != 0:
+            raise ConnectionError("dk_send_frame failed")
+    else:
+        sock.sendall(struct.pack(">Q", len(payload)) + payload)
+
+
+def recv_frame(sock: socket.socket) -> Optional[bytes]:
+    """One frame, or None on clean EOF."""
+    lib = _load_native()
+    if lib:
+        size = lib.dk_recv_frame_size(sock.fileno())
+        if size < 0:
+            return None
+        buf = ctypes.create_string_buffer(size)
+        if lib.dk_recv_exact(sock.fileno(), buf, size) != 0:
+            return None
+        return buf.raw
+    header = _recv_exact_py(sock, 8)
+    if header is None:
+        return None
+    (size,) = struct.unpack(">Q", header)
+    return _recv_exact_py(sock, size)
+
+
+def _recv_exact_py(sock: socket.socket, n: int) -> Optional[bytes]:
+    chunks = []
+    got = 0
+    while got < n:
+        chunk = sock.recv(n - got)
+        if not chunk:
+            return None
+        chunks.append(chunk)
+        got += len(chunk)
+    return b"".join(chunks)
+
+
+def send_msg(sock: socket.socket, obj: Any):
+    """Pytree/dict → msgpack frame (reference: send_data, minus pickle)."""
+    send_frame(sock, flax_serialization.msgpack_serialize(obj))
+
+
+def recv_msg(sock: socket.socket) -> Any:
+    data = recv_frame(sock)
+    if data is None:
+        return None
+    return flax_serialization.msgpack_restore(data)
+
+
+def determine_host_address() -> str:
+    """Best-effort routable address of this host (reference:
+    networking.py · determine_host_address)."""
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.connect(("8.8.8.8", 80))  # no packets sent; just picks a route
+        addr = s.getsockname()[0]
+        s.close()
+        return addr
+    except OSError:
+        return "127.0.0.1"
+
+
+def connect(host: str, port: int, disable_nagle: bool = True) -> socket.socket:
+    """Reference: networking.py · connect — TCP with Nagle off for the
+    small-framed control path."""
+    sock = socket.create_connection((host, port))
+    if disable_nagle:
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    return sock
+
+
+# ---------------------------------------------------------------------------
+# Parameter-server service + remote proxy
+# ---------------------------------------------------------------------------
+
+class ParameterServerService:
+    """Expose a :class:`~distkeras_tpu.parameter_servers.ParameterServer`
+    over TCP (reference: parameter_servers.py · SocketParameterServer's
+    accept loop + per-connection handler threads)."""
+
+    def __init__(self, ps, host: str = "0.0.0.0", port: int = 0):
+        self.ps = ps
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self.port = self._sock.getsockname()[1]
+        self._threads = []
+        self._running = False
+
+    def start(self):
+        self._running = True
+        self._sock.listen(64)
+        t = threading.Thread(target=self._accept_loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def _accept_loop(self):
+        while self._running:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            t = threading.Thread(
+                target=self._handle, args=(conn,), daemon=True
+            )
+            t.start()
+            self._threads.append(t)
+
+    def _handle(self, conn: socket.socket):
+        """Per-connection dispatch (reference: the 1-byte 'c'/'p' action
+        protocol, upgraded to named ops)."""
+        try:
+            while True:
+                try:
+                    msg = recv_msg(conn)
+                except Exception:  # malformed frame: drop this client only
+                    return
+                if msg is None or not isinstance(msg, dict):
+                    return
+                op = msg.get("op")
+                if op == "pull":
+                    send_msg(conn, {"value": self.ps.pull()})
+                elif op == "pull_with_clock":
+                    value, clock = self.ps.pull_with_clock()
+                    send_msg(conn, {"value": value, "clock": clock})
+                elif op == "commit":
+                    self.ps.commit(
+                        msg["delta"], worker=int(msg.get("worker", 0)),
+                        worker_clock=int(msg.get("clock", 0)),
+                    )
+                    send_msg(conn, {"ok": 1})
+                elif op == "commit_and_wait":
+                    center = self.ps.commit_and_wait(
+                        msg["params"], worker=int(msg.get("worker", 0))
+                    )
+                    send_msg(conn, {"value": center})
+                elif op == "leave":
+                    self.ps.leave(int(msg.get("worker", 0)))
+                    send_msg(conn, {"ok": 1})
+                elif op == "num_updates":
+                    send_msg(conn, {"value": self.ps.num_updates})
+                else:
+                    send_msg(conn, {"error": f"unknown op {op!r}"})
+        except (ConnectionError, OSError):
+            return
+        finally:
+            conn.close()
+
+    def stop(self):
+        self._running = False
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class RemoteParameterServer:
+    """Client proxy with the same method surface as a local
+    :class:`ParameterServer`, so workers are transport-agnostic
+    (reference: workers.py · NetworkWorker.connect/pull/push)."""
+
+    def __init__(self, host: str, port: int):
+        self.host, self.port = host, port
+        self._local = threading.local()
+
+    def _sock(self) -> socket.socket:
+        # one connection per worker thread, mirroring the reference's
+        # per-executor connection
+        if not hasattr(self._local, "sock"):
+            self._local.sock = connect(self.host, self.port)
+        return self._local.sock
+
+    def _call(self, msg: dict) -> dict:
+        sock = self._sock()
+        send_msg(sock, msg)
+        reply = recv_msg(sock)
+        if reply is None:
+            raise ConnectionError("parameter server closed the connection")
+        if "error" in reply:
+            raise RuntimeError(reply["error"])
+        return reply
+
+    # -- ParameterServer surface -------------------------------------------
+
+    def start(self):
+        pass
+
+    def stop(self):
+        pass
+
+    def pull(self):
+        return self._call({"op": "pull"})["value"]
+
+    def pull_with_clock(self):
+        r = self._call({"op": "pull_with_clock"})
+        return r["value"], int(r["clock"])
+
+    def commit(self, delta, worker: int = 0, worker_clock: int = 0):
+        self._call({"op": "commit", "delta": _to_host(delta),
+                    "worker": worker, "clock": worker_clock})
+
+    def commit_and_wait(self, params, worker: int = 0):
+        return self._call(
+            {"op": "commit_and_wait", "params": _to_host(params),
+             "worker": worker}
+        )["value"]
+
+    def leave(self, worker: int = 0):
+        try:
+            self._call({"op": "leave", "worker": worker})
+        except (ConnectionError, RuntimeError):
+            pass
+
+    @property
+    def num_updates(self) -> int:
+        return int(self._call({"op": "num_updates"})["value"])
+
+    def close(self):
+        if hasattr(self._local, "sock"):
+            self._local.sock.close()

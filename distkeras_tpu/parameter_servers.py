@@ -50,6 +50,26 @@ class ParameterServer:
         self.num_updates = 0
         self.staleness_log: List[int] = []
         self._running = False
+        self.checkpointer = None  # optional; set by DistributedTrainer
+
+    def _committed(self):
+        """Post-commit bookkeeping (caller holds the lock): count the update
+        and, on the configured cadence, snapshot the center for a checkpoint.
+        Returns the pending snapshot — the caller saves it AFTER releasing
+        the lock so checkpoint I/O never stalls concurrent commits."""
+        self.num_updates += 1
+        if (
+            self.checkpointer is not None
+            and self.num_updates % self.checkpointer.every_steps == 0
+        ):
+            return self.num_updates, jax.tree.map(np.copy, self.center)
+        return None
+
+    def _save_pending(self, pending):
+        """Write a snapshot returned by :meth:`_committed` (lock released)."""
+        if pending is not None and self.checkpointer is not None:
+            step, snapshot = pending
+            self.checkpointer.maybe_save(step, snapshot)
 
     # -- lifecycle (reference: initialize/start/run/stop/get_model) --------
 
@@ -84,7 +104,8 @@ class DeltaParameterServer(ParameterServer):
     def commit(self, delta, worker: int = 0, worker_clock: int = 0):
         with self.lock:
             self.center = rules.downpour_commit(self.center, _to_host(delta))
-            self.num_updates += 1
+            pending = self._committed()
+        self._save_pending(pending)
 
 
 class ADAGParameterServer(ParameterServer):
@@ -100,7 +121,8 @@ class ADAGParameterServer(ParameterServer):
             self.center = rules.adag_commit(
                 self.center, _to_host(delta), self.num_workers
             )
-            self.num_updates += 1
+            pending = self._committed()
+        self._save_pending(pending)
 
 
 class DynSGDParameterServer(ParameterServer):
@@ -125,7 +147,9 @@ class DynSGDParameterServer(ParameterServer):
                 self.center, _to_host(delta), staleness
             )
             self.clock += 1
-            self.num_updates += 1
+            pending = self._committed()
+        self._save_pending(pending)
+        return
 
 
 class EASGDParameterServer(ParameterServer):
@@ -154,7 +178,7 @@ class EASGDParameterServer(ParameterServer):
         self.center = rules.easgd_center_update(
             self.center, list(self._round_inputs.values()), self.alpha
         )
-        self.num_updates += 1
+        self._pending_ckpt = self._committed()
         self._round_center = pre_center
         self._round_inputs = {}
         self._round += 1
@@ -176,9 +200,13 @@ class EASGDParameterServer(ParameterServer):
             self._round_inputs[worker] = _to_host(worker_params)
             if len(self._round_inputs) >= len(self._active):
                 self._round_complete_locked()
+                pending = self.__dict__.pop("_pending_ckpt", None)
             else:
                 self._cond.wait_for(lambda: self._round > my_round)
-            return self._round_center
+                pending = None
+            center = self._round_center
+        self._save_pending(pending)
+        return center
 
     def leave(self, worker: int):
         with self._cond:
@@ -188,6 +216,8 @@ class EASGDParameterServer(ParameterServer):
                 self._round_complete_locked()
             elif not self._active:
                 self._cond.notify_all()
+            pending = self.__dict__.pop("_pending_ckpt", None)
+        self._save_pending(pending)
 
     def commit(self, delta, worker: int = 0, worker_clock: int = 0):
         raise TypeError(

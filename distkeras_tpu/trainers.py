@@ -61,6 +61,7 @@ class Trainer:
         batch_size: int = 32,
         num_epoch: int = 1,
         seed: int = 0,
+        checkpointer=None,
     ):
         self.model = model
         self.params = params
@@ -73,6 +74,7 @@ class Trainer:
         self.batch_size = batch_size
         self.num_epoch = num_epoch
         self.seed = seed
+        self.checkpointer = checkpointer
         self.history: History = []
         self.executor_histories: List[History] = []
         self._t_start = None
@@ -141,10 +143,39 @@ class SingleTrainer(Trainer):
             dataset = dataset.shuffle(seed=self.seed)
         dataset = dataset.coalesce(1)
         self.ensure_params(dataset)
+        start_epoch = 0
+        restored_opt_state = None
+        if self.checkpointer is not None:
+            opt_template = get_optimizer(
+                self.worker_optimizer, self.learning_rate
+            ).init(self.params)
+            step, state = self.checkpointer.restore(like={
+                "params": self.params, "opt_state": opt_template,
+                "extra": {"epoch": 0},
+            })
+            if state is not None:
+                self.params = state["params"]
+                restored_opt_state = state["opt_state"] or None
+                start_epoch = int(state["extra"].get("epoch", step))
         worker = workers_mod.SequentialWorker(
             self.model, self.params, **self.worker_kwargs()
         )
+        worker.num_epoch = max(0, self.num_epoch - start_epoch)
+        worker.initial_opt_state = restored_opt_state
+        if self.checkpointer is not None:
+            ckpt = self.checkpointer
+
+            def _on_epoch(epoch, params, opt_state, _base=start_epoch):
+                ckpt.maybe_save(
+                    _base + epoch + 1, params, opt_state,
+                    extra={"epoch": _base + epoch + 1},
+                    force=(_base + epoch + 1 == self.num_epoch),
+                )
+
+            worker.epoch_callback = _on_epoch
         params, history = worker.train(0, dataset.partition(0))
+        if self.checkpointer is not None:
+            self.checkpointer.wait()
         self.record_training_end()
         self.params = params
         self.executor_histories = [history]
@@ -233,10 +264,15 @@ class DistributedTrainer(Trainer):
     WORKER_CLS = None  # set by subclasses
 
     def __init__(self, *args, num_workers: int = 2,
-                 communication_window: int = 5, **kwargs):
+                 communication_window: int = 5,
+                 remote_ps: Optional[tuple] = None, **kwargs):
         super().__init__(*args, **kwargs)
         self.num_workers = num_workers
         self.communication_window = communication_window
+        # (host, port) of a ParameterServerService on another host: this
+        # process then contributes workers over DCN instead of owning the
+        # center (multi-host async topology; see networking.py)
+        self.remote_ps = remote_ps
         self.parameter_server: Optional[ps_mod.ParameterServer] = None
 
     # reference: allocate_parameter_server / allocate_worker
@@ -263,7 +299,25 @@ class DistributedTrainer(Trainer):
         n_parts = self.num_workers * self.parallelism_factor
         dataset = dataset.repartition(n_parts)
         self.ensure_params(dataset)
-        ps = self.allocate_parameter_server()
+        if self.checkpointer is not None:
+            _, state = self.checkpointer.restore(
+                like={"params": self.params, "opt_state": {}, "extra": {}}
+            )
+            if state is not None:
+                self.params = state["params"]
+        if self.remote_ps is not None:
+            if self.checkpointer is not None:
+                raise ValueError(
+                    "checkpointer must live with the process that owns the "
+                    "center (the ParameterServerService host), not a "
+                    "remote_ps client — pass it there instead"
+                )
+            from distkeras_tpu.networking import RemoteParameterServer
+
+            ps = RemoteParameterServer(*self.remote_ps)
+        else:
+            ps = self.allocate_parameter_server()
+            ps.checkpointer = self.checkpointer
         self.parameter_server = ps
         ps.start()
 
@@ -290,10 +344,16 @@ class DistributedTrainer(Trainer):
         for t in threads:
             t.join()
         ps.stop()
+        if self.checkpointer is not None and self.remote_ps is None:
+            self.checkpointer.maybe_save(
+                ps.num_updates, ps.get_model(), extra={}, force=True
+            )
+            self.checkpointer.wait()
         if errors:
             raise errors[0]
         self.executor_histories = [h for h in results if h is not None]
-        self.params = jax.tree.map(jnp.asarray, ps.get_model())
+        final = ps.pull() if self.remote_ps is not None else ps.get_model()
+        self.params = jax.tree.map(jnp.asarray, final)
         self.record_training_end()
         return Model(self.model, self.params)
 
@@ -478,11 +538,27 @@ class DataParallelTrainer(Trainer):
 
         params = self.params
         opt_state = optimizer.init(params)
+        start_epoch = 0
+        if self.checkpointer is not None:
+            step, state = self.checkpointer.restore(like={
+                "params": params, "opt_state": opt_state,
+                "extra": {"epoch": 0},
+            })
+            if state is not None:
+                params = state["params"]
+                opt_state = state["opt_state"] or opt_state
+                start_epoch = int(state["extra"].get("epoch", step))
         history: History = []
-        for _ in range(self.num_epoch):
+        for epoch in range(start_epoch, self.num_epoch):
             params, opt_state, ms = sharded_epoch(
                 params, opt_state, jnp.asarray(xb), jnp.asarray(yb)
             )
+            if self.checkpointer is not None:
+                self.checkpointer.maybe_save(
+                    epoch + 1, params, opt_state,
+                    extra={"epoch": epoch + 1},
+                    force=(epoch + 1 == self.num_epoch),
+                )
             ms = {k: np.asarray(v) for k, v in ms.items()}
             for t in range(len(xb)):
                 history.append({k: float(v[t]) for k, v in ms.items()})

@@ -1,0 +1,64 @@
+"""Structured training metrics.
+
+The reference's observability is per-batch loss lists + a PS update counter
+(SURVEY.md §5.5). This module upgrades that to structured per-step records
+with derived throughput and staleness statistics, written as JSON lines so
+any downstream tool can consume them.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, List, Optional
+
+
+class MetricsWriter:
+    """Append-only JSONL metrics sink with wall-clock and throughput
+    bookkeeping. Thread-safe enough for the async trainers (one writer;
+    the GIL serializes appends; flush on close)."""
+
+    def __init__(self, path: Optional[str] = None):
+        self.path = path
+        self._records: List[dict] = []
+        self._fh = open(path, "a") if path else None
+        self._t0 = time.time()
+
+    def log(self, step: int, samples: Optional[int] = None, **scalars):
+        rec = {"step": int(step), "t": round(time.time() - self._t0, 6)}
+        if samples is not None:
+            rec["samples"] = int(samples)
+        for k, v in scalars.items():
+            rec[k] = float(v)
+        self._records.append(rec)
+        if self._fh:
+            self._fh.write(json.dumps(rec) + "\n")
+
+    @property
+    def records(self) -> List[dict]:
+        return list(self._records)
+
+    def throughput(self) -> Optional[float]:
+        """Overall samples/sec across logged records (None without samples)."""
+        with_samples = [r for r in self._records if "samples" in r]
+        if len(with_samples) < 2:
+            return None
+        total = sum(r["samples"] for r in with_samples[1:])
+        dt = with_samples[-1]["t"] - with_samples[0]["t"]
+        return total / dt if dt > 0 else None
+
+    def close(self):
+        if self._fh:
+            self._fh.flush()
+            self._fh.close()
+            self._fh = None
+
+
+def staleness_histogram(staleness_log: List[int]) -> Dict[int, int]:
+    """Histogram of commit staleness from a parameter server's log
+    (DynSGD records these; see parameter_servers.py)."""
+    out: Dict[int, int] = {}
+    for s in staleness_log:
+        out[s] = out.get(s, 0) + 1
+    return dict(sorted(out.items()))

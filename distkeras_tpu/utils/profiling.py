@@ -1,0 +1,53 @@
+"""Profiling hooks.
+
+The reference's only instrumentation is wall-clock training time
+(SURVEY.md §5.1). Here: a context manager around ``jax.profiler`` producing
+a TensorBoard-loadable XLA trace, plus a simple step timer that avoids the
+async-dispatch pitfall (device work must be fetched, not merely dispatched,
+before reading the clock — see bench.py).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Optional
+
+import jax
+import numpy as np
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """``with trace('/tmp/profile'):`` → XLA device trace in ``log_dir``
+    (view with TensorBoard's profile plugin or xprof)."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+class StepTimer:
+    """Wall-clock timer that forces completion of a jax value before each
+    reading, so timings measure compute rather than dispatch."""
+
+    def __init__(self):
+        self.durations: list = []
+        self._t: Optional[float] = None
+
+    def start(self):
+        self._t = time.perf_counter()
+
+    def stop(self, sync_on=None) -> float:
+        if sync_on is not None:
+            jax.tree.map(
+                lambda a: np.asarray(a) if hasattr(a, "dtype") else a, sync_on
+            )
+        dt = time.perf_counter() - self._t
+        self.durations.append(dt)
+        return dt
+
+    @property
+    def mean(self) -> float:
+        return float(np.mean(self.durations)) if self.durations else 0.0

@@ -183,7 +183,10 @@ class Worker:
             self.window_step = make_window_step(
                 self.module.apply, self.loss_fn, self.optimizer, self.metrics
             )
-        self.opt_state = self.optimizer.init(self.params)
+        restored = getattr(self, "initial_opt_state", None)
+        self.opt_state = (
+            restored if restored is not None else self.optimizer.init(self.params)
+        )
 
     def batches(self, partition) -> Tuple[np.ndarray, np.ndarray]:
         return batch_partition(
@@ -205,13 +208,16 @@ class SequentialWorker(Worker):
         xb, yb = self.batches(partition)
         params, opt_state = self.params, self.opt_state
         history: History = []
-        for _ in range(self.num_epoch):
+        callback = getattr(self, "epoch_callback", None)
+        for epoch in range(self.num_epoch):
             params, opt_state, ms = self.window_step(
                 params, opt_state, jnp.asarray(xb), jnp.asarray(yb)
             )
             ms = {k: np.asarray(v) for k, v in ms.items()}
             for t in range(len(xb)):
                 history.append({k: float(v[t]) for k, v in ms.items()})
+            if callback is not None:
+                callback(epoch, params, opt_state)
         self.params = params
         return params, history
 

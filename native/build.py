@@ -1,0 +1,31 @@
+"""Build the native transport library: ``python native/build.py``.
+
+Produces ``native/libdk_transport.so``; :mod:`distkeras_tpu.networking`
+auto-builds on first use if a compiler is available and falls back to the
+pure-Python framing otherwise.
+"""
+
+import os
+import shutil
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+SRC = os.path.join(HERE, "dk_transport.c")
+OUT = os.path.join(HERE, "libdk_transport.so")
+
+
+def build(quiet: bool = False) -> str:
+    cc = os.environ.get("CC") or shutil.which("cc") or shutil.which("gcc") \
+        or shutil.which("clang")
+    if cc is None:
+        raise RuntimeError("no C compiler found")
+    cmd = [cc, "-O2", "-shared", "-fPIC", "-o", OUT, SRC]
+    subprocess.run(cmd, check=True,
+                   capture_output=quiet)
+    return OUT
+
+
+if __name__ == "__main__":
+    print(build())
+    sys.exit(0)

@@ -1,0 +1,72 @@
+/* dk_transport — native framed-socket data plane.
+ *
+ * Reference: distkeras/networking.py sends pickled weight blobs with a
+ * fixed-size length header over TCP from Python. This is the rebuilt data
+ * plane: the framing + full-buffer send/recv loops live in C, called via
+ * ctypes (which releases the GIL for the duration of each call), so
+ * parameter-server handler threads stream multi-megabyte weight frames
+ * without holding the interpreter lock, and short writes/reads are retried
+ * at native speed.
+ *
+ * Wire format: 8-byte big-endian payload length, then payload bytes.
+ * Build: cc -O2 -shared -fPIC -o libdk_transport.so dk_transport.c
+ */
+
+#include <errno.h>
+#include <stdint.h>
+#include <stddef.h>
+#include <string.h>
+#include <unistd.h>
+#include <sys/socket.h>
+#include <sys/types.h>
+
+static int write_all(int fd, const unsigned char *buf, uint64_t len) {
+    uint64_t off = 0;
+    while (off < len) {
+        ssize_t n = send(fd, buf + off, len - off, MSG_NOSIGNAL);
+        if (n < 0) {
+            if (errno == EINTR) continue;
+            return -1;
+        }
+        if (n == 0) return -1;
+        off += (uint64_t)n;
+    }
+    return 0;
+}
+
+static int read_all(int fd, unsigned char *buf, uint64_t len) {
+    uint64_t off = 0;
+    while (off < len) {
+        ssize_t n = recv(fd, buf + off, len - off, 0);
+        if (n < 0) {
+            if (errno == EINTR) continue;
+            return -1;
+        }
+        if (n == 0) return -1; /* peer closed */
+        off += (uint64_t)n;
+    }
+    return 0;
+}
+
+/* Send one frame: header + payload. Returns 0 on success, -1 on error. */
+int dk_send_frame(int fd, const unsigned char *buf, uint64_t len) {
+    unsigned char hdr[8];
+    for (int i = 0; i < 8; i++) hdr[i] = (unsigned char)(len >> (8 * (7 - i)));
+    if (write_all(fd, hdr, 8) != 0) return -1;
+    return write_all(fd, buf, len);
+}
+
+/* Read the 8-byte header. Returns payload length, or -1 on error/EOF. */
+int64_t dk_recv_frame_size(int fd) {
+    unsigned char hdr[8];
+    if (read_all(fd, hdr, 8) != 0) return -1;
+    uint64_t len = 0;
+    for (int i = 0; i < 8; i++) len = (len << 8) | hdr[i];
+    if (len > (uint64_t)INT64_MAX) return -1;
+    return (int64_t)len;
+}
+
+/* Read exactly len payload bytes into buf. Returns 0 / -1. */
+int dk_recv_exact(int fd, unsigned char *buf, uint64_t len) {
+    return read_all(fd, buf, len);
+}

@@ -1,0 +1,34 @@
+"""Metrics writer + staleness histogram + step timer."""
+
+import json
+
+from distkeras_tpu.utils.metrics import MetricsWriter, staleness_histogram
+from distkeras_tpu.utils.profiling import StepTimer
+
+
+def test_metrics_writer_jsonl(tmp_path):
+    path = tmp_path / "metrics.jsonl"
+    w = MetricsWriter(str(path))
+    for i in range(5):
+        w.log(step=i, samples=64, loss=1.0 / (i + 1))
+    w.close()
+    lines = [json.loads(line) for line in path.read_text().splitlines()]
+    assert len(lines) == 5
+    assert lines[0]["step"] == 0 and lines[-1]["loss"] == 0.2
+    assert all("t" in r and r["samples"] == 64 for r in lines)
+    assert w.throughput() is None or w.throughput() > 0
+
+
+def test_staleness_histogram():
+    assert staleness_histogram([0, 0, 1, 3, 1, 0]) == {0: 3, 1: 2, 3: 1}
+    assert staleness_histogram([]) == {}
+
+
+def test_step_timer():
+    import jax.numpy as jnp
+
+    t = StepTimer()
+    t.start()
+    x = jnp.arange(1000.0).sum()
+    dt = t.stop(sync_on=x)
+    assert dt > 0 and t.mean > 0

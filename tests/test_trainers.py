@@ -239,9 +239,10 @@ def test_easgd_rho_knob_is_live():
         elastic_lr=0.05,
         **dict(TRAIN_KW, num_epoch=1),
     )
-    trainer.train(ds)
     import jax
-    init = trainer.ensure_params(ds)
+    init = trainer.ensure_params(ds)  # captured BEFORE training
+    init = jax.tree.map(np.copy, init)
+    trainer.train(ds)
     final = trainer.parameter_server.get_model()
     for a, b in zip(jax.tree.leaves(init), jax.tree.leaves(final)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b))
