@@ -1,0 +1,28 @@
+"""Packaging (reference: setup.py — pip metadata for distkeras).
+
+The native transport library is built on demand at import time (see
+distkeras_tpu/networking.py); ``build_native`` below lets packagers do it
+eagerly.
+"""
+
+from setuptools import find_packages, setup
+
+setup(
+    name="distkeras-tpu",
+    version="0.1.0",
+    description=(
+        "TPU-native distributed deep learning: data-parallel trainers "
+        "(DOWNPOUR, ADAG, EASGD/AEASGD/EAMSGD, DynSGD), partitioned-dataset "
+        "pipelines, and batch inference on JAX/XLA"
+    ),
+    packages=find_packages(include=["distkeras_tpu", "distkeras_tpu.*"]),
+    python_requires=">=3.10",
+    install_requires=[
+        "jax",
+        "flax",
+        "optax",
+        "orbax-checkpoint",
+        "numpy",
+    ],
+    extras_require={"test": ["pytest"]},
+)

@@ -1,0 +1,70 @@
+"""Example workflows as integration tests (the reference's QA model:
+'does the notebook run and reach ~expected accuracy', SURVEY.md §4)."""
+
+import sys
+
+import pytest
+
+
+def run_example(monkeypatch, module_name, argv):
+    import importlib
+
+    monkeypatch.setattr(sys, "argv", argv)
+    mod = importlib.import_module(module_name)
+    mod.main()
+
+
+def test_mnist_workflow_smoke(monkeypatch, capsys):
+    sys.path.insert(0, "examples")
+    run_example(
+        monkeypatch, "mnist_workflow",
+        ["mnist_workflow.py", "--trainers", "single,adag",
+         "--workers", "2", "--epochs", "1", "--n", "1024",
+         "--batch-size", "64", "--model", "mlp"],
+    )
+    out = capsys.readouterr().out
+    assert "accuracy=" in out and "best:" in out
+
+
+def test_cifar_example_smoke(monkeypatch, capsys):
+    sys.path.insert(0, "examples")
+    run_example(
+        monkeypatch, "cifar10_training",
+        ["cifar10_training.py", "--trainer", "dataparallel",
+         "--epochs", "1", "--n", "512", "--batch-size", "32",
+         "--workers", "2", "--small"],
+    )
+    out = capsys.readouterr().out
+    assert "samples/sec" in out and "accuracy" in out
+
+
+def test_job_deployment_local():
+    from distkeras_tpu.job_deployment import Job
+
+    job = Job(script="-c", script_args=["print('job ran ok')"],
+              hosts=["local"], python=sys.executable)
+    procs = job.run(wait=True)
+    assert all(p.returncode == 0 for p in procs)
+
+
+def test_job_deployment_command_construction():
+    from distkeras_tpu.job_deployment import Job
+
+    job = Job(script="train.py", script_args=["--epochs", "3"],
+              hosts=["local", "user@tpu-host-1"], ps_port=7001)
+    env0 = job.environment_for(0)
+    assert env0["DK_TPU_PROCESS_ID"] == "0"
+    assert env0["DK_TPU_NUM_PROCESSES"] == "2"
+    assert env0["DK_TPU_PS_ADDRESS"].endswith(":7001")
+    cmd1 = job.command_for(1)
+    assert cmd1[0] == "ssh" and "user@tpu-host-1" in cmd1
+    assert "train.py" in cmd1[-1] and "--epochs 3" in cmd1[-1]
+
+
+def test_job_deployment_failure_raises():
+    from distkeras_tpu.job_deployment import Job
+
+    job = Job(script="-c", script_args=["raise SystemExit(3)"],
+              hosts=["local"], python=sys.executable)
+    with pytest.raises(RuntimeError, match="failed"):
+        job.run(wait=True)
