@@ -3,9 +3,20 @@
 The reference has NO checkpointing (SURVEY.md §5.4): the trained model
 exists only in driver memory until the user calls Keras ``save()``; a
 crashed run restarts from scratch. This module is the capability win the
-survey calls for: orbax-backed save/restore of the full training state
-(params + optimizer state + step counter + data-order seed), so any trainer
-can resume mid-run deterministically.
+survey calls for: orbax-backed save/restore of the training state, one
+pytree ``{"params", "opt_state", "extra"}`` per step directory.
+
+Resume semantics by trainer:
+
+- ``SingleTrainer`` / ``DataParallelTrainer``: params + optimizer state +
+  epoch counter are saved per epoch; resume replays the exact remaining
+  trajectory (tested in tests/test_checkpoint.py).
+- ``DistributedTrainer`` (async PS family): snapshots carry the center
+  params plus every worker's optimizer state (read racily mid-run — see
+  parameter_servers.ParameterServer.extra_state_fn) and ``n_workers``.
+  Resume restores center + worker optimizer states when the worker count
+  matches, else center only; epoch/commit progress is NOT resumed — the
+  restarted run trains its full ``num_epoch`` from the restored state.
 
 Usage::
 
@@ -30,8 +41,9 @@ import orbax.checkpoint as ocp
 class Checkpointer:
     """Thin wrapper over an orbax ``CheckpointManager``.
 
-    State layout: one pytree ``{"params": ..., "opt_state": ..., "step": n,
-    "seed": s}`` per step directory.
+    State layout: one pytree ``{"params": ..., "opt_state": ...,
+    "extra": {...}}`` per step directory (``extra`` holds small metadata
+    like epoch counters or the async trainers' ``n_workers``).
     """
 
     def __init__(self, directory: str, every_steps: int = 100,

@@ -51,6 +51,18 @@ class ParameterServer:
         self.staleness_log: List[int] = []
         self._running = False
         self.checkpointer = None  # optional; set by DistributedTrainer
+        # save-step offset: a resumed run seeds this with the restored
+        # checkpoint's step so its snapshot steps continue monotonically
+        # past the prior run's instead of colliding (colliding steps are
+        # skipped by the checkpointer, which would silently drop the
+        # resumed run's saves)
+        self.step_offset = 0
+        # optional () -> (opt_state_tree, extra_dict) supplied by the
+        # trainer so snapshots carry worker optimizer state alongside the
+        # center (worker states are read racily — for the async algorithms
+        # an approximately-current momentum on crash-resume is semantically
+        # fine; asynchrony is the algorithm)
+        self.extra_state_fn = None
 
     def _committed(self):
         """Post-commit bookkeeping (caller holds the lock): count the update
@@ -62,14 +74,22 @@ class ParameterServer:
             self.checkpointer is not None
             and self.num_updates % self.checkpointer.every_steps == 0
         ):
-            return self.num_updates, jax.tree.map(np.copy, self.center)
+            return self.step_offset + self.num_updates, jax.tree.map(
+                np.copy, self.center
+            )
         return None
 
     def _save_pending(self, pending):
         """Write a snapshot returned by :meth:`_committed` (lock released)."""
         if pending is not None and self.checkpointer is not None:
             step, snapshot = pending
-            self.checkpointer.maybe_save(step, snapshot)
+            opt_state, extra = (
+                self.extra_state_fn() if self.extra_state_fn is not None
+                else (None, None)
+            )
+            self.checkpointer.maybe_save(
+                step, snapshot, opt_state=opt_state, extra=extra
+            )
 
     # -- lifecycle (reference: initialize/start/run/stop/get_model) --------
 

@@ -265,7 +265,8 @@ class DistributedTrainer(Trainer):
 
     def __init__(self, *args, num_workers: int = 2,
                  communication_window: int = 5,
-                 remote_ps: Optional[tuple] = None, **kwargs):
+                 remote_ps: Optional[tuple] = None,
+                 devices: Optional[Sequence] = None, **kwargs):
         super().__init__(*args, **kwargs)
         self.num_workers = num_workers
         self.communication_window = communication_window
@@ -273,7 +274,13 @@ class DistributedTrainer(Trainer):
         # process then contributes workers over DCN instead of owning the
         # center (multi-host async topology; see networking.py)
         self.remote_ps = remote_ps
+        # Devices the worker step loops are pinned to, round-robin. Default:
+        # all local devices — N async workers on an N-chip host drive N
+        # chips concurrently (the reference's one-worker-per-executor
+        # topology, with chips playing the executors).
+        self.devices = devices
         self.parameter_server: Optional[ps_mod.ParameterServer] = None
+        self.workers: List[workers_mod.WindowedWorker] = []
 
     # reference: allocate_parameter_server / allocate_worker
     def allocate_parameter_server(self) -> ps_mod.ParameterServer:
@@ -283,6 +290,8 @@ class DistributedTrainer(Trainer):
         kwargs = self.worker_kwargs()
         kwargs.update(communication_window=self.communication_window)
         kwargs.update(self.extra_worker_kwargs())
+        devices = self.devices if self.devices is not None else jax.local_devices()
+        kwargs.update(device=devices[index % len(devices)])
         return self.WORKER_CLS(self.model, self.params, **kwargs)
 
     def extra_worker_kwargs(self) -> dict:
@@ -299,12 +308,29 @@ class DistributedTrainer(Trainer):
         n_parts = self.num_workers * self.parallelism_factor
         dataset = dataset.repartition(n_parts)
         self.ensure_params(dataset)
-        if self.checkpointer is not None:
-            _, state = self.checkpointer.restore(
-                like={"params": self.params, "opt_state": {}, "extra": {}}
-            )
-            if state is not None:
+        restored_worker_opt = None
+        restored_step = 0
+        if self.checkpointer is not None and self.checkpointer.latest_step is not None:
+            # Checkpoints carry the center plus each worker's optimizer
+            # state (reference parity: Keras set_weights kept the optimizer
+            # state across weight swaps, so resume must too). The typed
+            # restore assumes the worker count matches; when it doesn't
+            # (topology change, or a pre-r2 params-only snapshot) the
+            # structure mismatch raises and we fall back to center-only.
+            opt_template = get_optimizer(
+                self.worker_optimizer, self.learning_rate
+            ).init(self.params)
+            try:
+                restored_step, state = self.checkpointer.restore(like={
+                    "params": self.params,
+                    "opt_state": {"workers": [opt_template] * n_parts},
+                    "extra": {"n_workers": 0},
+                })
                 self.params = state["params"]
+                restored_worker_opt = state["opt_state"]["workers"]
+            except Exception:
+                restored_step, raw = self.checkpointer.restore()
+                self.params = jax.tree.map(np.asarray, raw["params"])
         if self.remote_ps is not None:
             if self.checkpointer is not None:
                 raise ValueError(
@@ -318,6 +344,10 @@ class DistributedTrainer(Trainer):
         else:
             ps = self.allocate_parameter_server()
             ps.checkpointer = self.checkpointer
+            # continue save steps past the restored run's so a resumed
+            # run's snapshots never collide with (and get skipped against)
+            # the prior run's steps
+            ps.step_offset = restored_step
         self.parameter_server = ps
         ps.start()
 
@@ -325,7 +355,24 @@ class DistributedTrainer(Trainer):
         errors: List[BaseException] = []
 
         workers = [self.allocate_worker(i) for i in range(n_parts)]
+        self.workers = workers
         workers_mod.share_compiled(workers)
+        if restored_worker_opt is not None:
+            for w, s in zip(workers, restored_worker_opt):
+                w.initial_opt_state = s
+        if self.checkpointer is not None and self.remote_ps is None:
+            fallback_opt = workers[0].optimizer.init(self.params)
+
+            def _worker_states():
+                states = []
+                for w in workers:
+                    s = getattr(w, "opt_state", None)
+                    states.append(jax.tree.map(
+                        np.asarray, s if s is not None else fallback_opt
+                    ))
+                return {"workers": states}, {"n_workers": n_parts}
+
+            ps.extra_state_fn = _worker_states
 
         def run(i: int):
             try:
@@ -345,10 +392,15 @@ class DistributedTrainer(Trainer):
             t.join()
         ps.stop()
         if self.checkpointer is not None and self.remote_ps is None:
+            opt_state, extra = ps.extra_state_fn()
             self.checkpointer.maybe_save(
-                ps.num_updates, ps.get_model(), extra={}, force=True
+                ps.step_offset + ps.num_updates, ps.get_model(),
+                opt_state=opt_state, extra=extra, force=True,
             )
             self.checkpointer.wait()
+            # release the closure over device-resident worker state so the
+            # trainer object doesn't pin N workers' opt_state in HBM
+            ps.extra_state_fn = None
         if errors:
             raise errors[0]
         self.executor_histories = [h for h in results if h is not None]

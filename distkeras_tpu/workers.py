@@ -156,6 +156,7 @@ class Worker:
         label_col: str = "label",
         batch_size: int = 32,
         num_epoch: int = 1,
+        device=None,
     ):
         self.module = module
         self.params = params
@@ -166,6 +167,30 @@ class Worker:
         self.label_col = label_col
         self.batch_size = batch_size
         self.num_epoch = num_epoch
+        # The device this worker's step loop runs on. The reference ran one
+        # worker per Spark executor; here N workers on an N-chip host each
+        # pin to their own chip (committed inputs steer jit dispatch), so
+        # async trainers drive all chips instead of queueing on device 0.
+        self.device = device
+        # Partitions no bigger than this are uploaded to the device once
+        # and kept resident (zero re-upload across epochs/windows); bigger
+        # ones are staged window-by-window so a partition larger than free
+        # HBM still trains.
+        self.stage_limit_bytes = 1 << 30
+
+    def _put(self, tree):
+        """Move a pytree onto this worker's device (committed), or just
+        densify on the default device when no device was assigned."""
+        if self.device is not None:
+            return jax.device_put(tree, self.device)
+        return jax.tree.map(jnp.asarray, tree)
+
+    def _stage(self, xb: np.ndarray, yb: np.ndarray):
+        """Upload the whole partition once if it fits the staging budget;
+        otherwise leave it on host (callers then stage per-window slices)."""
+        if xb.nbytes + yb.nbytes <= self.stage_limit_bytes:
+            return self._put(xb), self._put(yb), True
+        return xb, yb, False
 
     def set_compiled(self, step, window_step):
         """Install shared jit-compiled step functions (built once by the
@@ -183,8 +208,9 @@ class Worker:
             self.window_step = make_window_step(
                 self.module.apply, self.loss_fn, self.optimizer, self.metrics
             )
+        self.params = self._put(self.params)
         restored = getattr(self, "initial_opt_state", None)
-        self.opt_state = (
+        self.opt_state = self._put(
             restored if restored is not None else self.optimizer.init(self.params)
         )
 
@@ -206,12 +232,17 @@ class SequentialWorker(Worker):
     def train(self, index: int, partition) -> Tuple[object, History]:
         self.prepare()
         xb, yb = self.batches(partition)
+        # one host->device upload for the whole run when it fits HBM
+        # (else per-epoch upload, the pre-staging behavior)
+        xb_d, yb_d, staged = self._stage(xb, yb)
         params, opt_state = self.params, self.opt_state
         history: History = []
         callback = getattr(self, "epoch_callback", None)
         for epoch in range(self.num_epoch):
+            if not staged:
+                xb_d, yb_d = self._put(xb), self._put(yb)
             params, opt_state, ms = self.window_step(
-                params, opt_state, jnp.asarray(xb), jnp.asarray(yb)
+                params, opt_state, xb_d, yb_d
             )
             ms = {k: np.asarray(v) for k, v in ms.items()}
             for t in range(len(xb)):
@@ -241,7 +272,7 @@ class WindowedWorker(Worker):
 
     def on_start(self, index: int, ps):
         """Initial pull (reference · NetworkWorker: connect + first pull)."""
-        self.params = ps.pull()
+        self.params = self._put(ps.pull())
         self.last_pulled = self.params
 
     def on_round(self, index: int, ps):
@@ -251,6 +282,9 @@ class WindowedWorker(Worker):
         self.prepare()
         self.on_start(index, ps)
         xb, yb = self.batches(partition)
+        # whole partition resident on-device when it fits (windows slice
+        # on-device, zero re-upload); else stage one window at a time
+        xb, yb, staged = self._stage(xb, yb)
         n_batches = len(xb)
         W = self.communication_window
         history: History = []
@@ -260,9 +294,11 @@ class WindowedWorker(Worker):
                 stop = min(start + W, n_batches)
                 if stop - start == W:
                     # full window: one fused scan dispatch
+                    xw, yw = xb[start:stop], yb[start:stop]
+                    if not staged:
+                        xw, yw = self._put(xw), self._put(yw)
                     params, opt_state, ms = self.window_step(
-                        self.params, self.opt_state,
-                        jnp.asarray(xb[start:stop]), jnp.asarray(yb[start:stop]),
+                        self.params, self.opt_state, xw, yw,
                     )
                     self.params, self.opt_state = params, opt_state
                     ms = {k: np.asarray(v) for k, v in ms.items()}
@@ -270,9 +306,11 @@ class WindowedWorker(Worker):
                         history.append({k: float(v[t]) for k, v in ms.items()})
                 else:
                     for b in range(start, stop):
+                        xw, yw = xb[b], yb[b]
+                        if not staged:
+                            xw, yw = self._put(xw), self._put(yw)
                         self.params, self.opt_state, m = self.step(
-                            self.params, self.opt_state,
-                            jnp.asarray(xb[b]), jnp.asarray(yb[b]),
+                            self.params, self.opt_state, xw, yw,
                         )
                         history.append({k: float(v) for k, v in m.items()})
                 self.on_round(index, ps)
@@ -290,7 +328,7 @@ class DOWNPOURWorker(WindowedWorker):
         self.worker_clock += 1
         # note: worker optimizer state persists across pulls, matching the
         # reference where set_weights() does not reset the Keras optimizer
-        self.params = ps.pull()
+        self.params = self._put(ps.pull())
         self.last_pulled = self.params
 
 
@@ -304,13 +342,15 @@ class DynSGDWorker(WindowedWorker):
     (reference: distkeras/workers.py · DynSGDWorker)."""
 
     def on_start(self, index: int, ps):
-        self.params, self.worker_clock = ps.pull_with_clock()
+        params, self.worker_clock = ps.pull_with_clock()
+        self.params = self._put(params)
         self.last_pulled = self.params
 
     def on_round(self, index: int, ps):
         delta = rules.downpour_delta(self.params, self.last_pulled)
         ps.commit(delta, worker=index, worker_clock=self.worker_clock)
-        self.params, self.worker_clock = ps.pull_with_clock()
+        params, self.worker_clock = ps.pull_with_clock()
+        self.params = self._put(params)
         self.last_pulled = self.params
 
 
@@ -328,7 +368,7 @@ class AEASGDWorker(WindowedWorker):
         self.alpha = elastic_lr * rho
 
     def on_round(self, index: int, ps):
-        center = ps.pull()
+        center = self._put(ps.pull())
         diff = rules.elastic_difference(self.alpha, self.params, center)
         self.params = rules.tree_sub(self.params, diff)
         ps.commit(diff, worker=index, worker_clock=self.worker_clock)
@@ -355,5 +395,5 @@ class EASGDWorker(WindowedWorker):
 
     def on_round(self, index: int, ps):
         # commit blocks until every worker has contributed to the round
-        center = ps.commit_and_wait(self.params, worker=index)
+        center = self._put(ps.commit_and_wait(self.params, worker=index))
         self.params = rules.easgd_worker_update(self.params, center, self.alpha)

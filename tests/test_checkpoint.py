@@ -67,6 +67,111 @@ def test_data_parallel_checkpoint_resume(tmp_path):
     assert len(t2.history) == len(t1.history)
 
 
+def test_async_resume_restores_worker_opt_state(tmp_path):
+    """VERDICT r1 #6: async resume must keep worker optimizer state.
+
+    With one worker DOWNPOUR is deterministic, so 2 epochs + resume for 2
+    more must equal 4 uninterrupted epochs exactly — only possible if the
+    momentum buffers survive the checkpoint boundary.
+    """
+    import jax
+
+    from distkeras_tpu.trainers import DOWNPOUR
+
+    ds = synthetic_dataset(n=512, partitions=1)
+    model_def = get_model("mlp", **MODEL_KW)
+    kw = dict(TRAIN_KW, worker_optimizer="momentum", num_epoch=4)
+
+    full = DOWNPOUR(model_def, num_workers=1, communication_window=2,
+                    seed=3, **kw)
+    full_model = full.train(ds)
+
+    ck1 = Checkpointer(str(tmp_path / "dp"), every_steps=10_000)
+    part = DOWNPOUR(model_def, num_workers=1, communication_window=2,
+                    seed=3, checkpointer=ck1, **dict(kw, num_epoch=2))
+    part.train(ds)
+    ck1.close()
+
+    ck2 = Checkpointer(str(tmp_path / "dp"), every_steps=10_000)
+    resumed = DOWNPOUR(model_def, num_workers=1, communication_window=2,
+                       seed=3, checkpointer=ck2, **dict(kw, num_epoch=2))
+    resumed_model = resumed.train(ds)
+    ck2.close()
+
+    for a, b in zip(
+        jax.tree.leaves(full_model.params),
+        jax.tree.leaves(resumed_model.params),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+        )
+
+
+def test_async_resume_saves_are_not_skipped(tmp_path):
+    """Regression: a resumed run's save steps must continue past the prior
+    run's (offset by the restored step), or its forced final save collides
+    with an existing step and is silently skipped — a second resume would
+    then restore the FIRST run's end state, losing all post-resume work."""
+    import jax
+
+    from distkeras_tpu.trainers import DOWNPOUR
+
+    ds = synthetic_dataset(n=512, partitions=1)
+    model_def = get_model("mlp", **MODEL_KW)
+    kw = dict(TRAIN_KW, worker_optimizer="momentum", num_epoch=2)
+
+    ck1 = Checkpointer(str(tmp_path / "c"), every_steps=10_000)
+    DOWNPOUR(model_def, num_workers=1, communication_window=2, seed=3,
+             checkpointer=ck1, **kw).train(ds)
+    step1 = ck1.latest_step
+    ck1.close()
+
+    ck2 = Checkpointer(str(tmp_path / "c"), every_steps=10_000)
+    t2 = DOWNPOUR(model_def, num_workers=1, communication_window=2, seed=3,
+                  checkpointer=ck2, **kw)
+    m2 = t2.train(ds)
+    assert ck2.latest_step > step1, "resumed run's final save was skipped"
+    ck2.close()
+
+    ck3 = Checkpointer(str(tmp_path / "c"), every_steps=10_000)
+    t3 = DOWNPOUR(model_def, num_workers=1, communication_window=2, seed=3,
+                  checkpointer=ck3, **dict(kw, num_epoch=0))
+    m3 = t3.train(ds)
+    ck3.close()
+    for a, b in zip(jax.tree.leaves(m2.params), jax.tree.leaves(m3.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+
+def test_async_resume_topology_change_keeps_center(tmp_path):
+    """A snapshot taken with 2 workers restores center-only into a 1-worker
+    run (worker optimizers start fresh) instead of failing."""
+    from distkeras_tpu.trainers import DOWNPOUR
+
+    ds = synthetic_dataset(n=512, partitions=2)
+    model_def = get_model("mlp", **MODEL_KW)
+
+    ck1 = Checkpointer(str(tmp_path / "topo"), every_steps=10_000)
+    t1 = DOWNPOUR(model_def, num_workers=2, communication_window=2,
+                  checkpointer=ck1, **dict(TRAIN_KW, num_epoch=1))
+    t1.train(ds)
+    saved_center = np.concatenate(
+        [np.asarray(x).ravel() for x in __import__("jax").tree.leaves(t1.params)]
+    )
+    ck1.close()
+
+    ck2 = Checkpointer(str(tmp_path / "topo"), every_steps=10_000)
+    t2 = DOWNPOUR(model_def, num_workers=1, communication_window=2,
+                  checkpointer=ck2, **dict(TRAIN_KW, num_epoch=0))
+    t2.train(ds)
+    ck2.close()
+    restored_center = np.concatenate(
+        [np.asarray(x).ravel() for x in __import__("jax").tree.leaves(t2.params)]
+    )
+    # num_epoch=0 ran no steps, so t2's center is exactly the restored one...
+    # modulo the final force-save happening after zero updates
+    np.testing.assert_allclose(saved_center, restored_center, rtol=1e-6)
+
+
 def test_async_ps_checkpoints_center(tmp_path):
     ds = synthetic_dataset(n=512, partitions=2)
     ck = Checkpointer(str(tmp_path / "adag"), every_steps=2)

@@ -1,0 +1,55 @@
+"""Async workers must actually occupy distinct devices (VERDICT r1 #2).
+
+The reference ran one worker per Spark executor; the TPU rebuild pins one
+worker step-loop per chip. On the virtual 8-device CPU mesh we assert the
+placement really happens — N workers → N distinct devices, with each
+worker's final params resident on its own device.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from distkeras_tpu.data.dataset import PartitionedDataset
+from distkeras_tpu.models import get_model
+from distkeras_tpu.trainers import ADAG, DOWNPOUR, EASGD
+
+
+def _dataset(n=512, d=8, classes=4, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    w = rng.normal(size=(d, classes)).astype(np.float32)
+    y = np.eye(classes, dtype=np.float32)[np.argmax(x @ w, axis=1)]
+    return PartitionedDataset.from_arrays(
+        {"features": x, "label": y}, num_partitions=4
+    )
+
+
+@pytest.mark.parametrize("trainer_cls", [DOWNPOUR, ADAG, EASGD])
+def test_workers_pin_distinct_devices(trainer_cls):
+    ds = _dataset()
+    trainer = trainer_cls(
+        model=get_model("mlp", features=(16,), num_classes=4),
+        num_workers=4, batch_size=32, num_epoch=1, communication_window=2,
+    )
+    trainer.train(ds)
+    assert len(trainer.workers) == 4
+    seen = [w.device for w in trainer.workers]
+    assert len(set(seen)) == 4, f"workers share devices: {seen}"
+    for w in trainer.workers:
+        for leaf in jax.tree.leaves(w.params):
+            assert leaf.devices() == {w.device}, (
+                f"params leaf on {leaf.devices()}, expected {{{w.device}}}"
+            )
+
+
+def test_devices_override_pins_to_given_device():
+    dev = jax.devices()[1]
+    ds = _dataset()
+    trainer = DOWNPOUR(
+        model=get_model("mlp", features=(16,), num_classes=4),
+        num_workers=2, batch_size=32, num_epoch=1, communication_window=2,
+        devices=[dev],
+    )
+    trainer.train(ds)
+    assert all(w.device == dev for w in trainer.workers)
