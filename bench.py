@@ -25,6 +25,38 @@ import jax.numpy as jnp
 
 BASELINE_SAMPLES_PER_SEC = 2000.0
 
+# peak bf16 TFLOP/s per chip by device kind (public spec sheets)
+PEAK_FLOPS = {
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5": 459e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+}
+
+
+def _flops_per_call(jitted, *args):
+    """XLA's own FLOP estimate for one call of a compiled function
+    (None when the backend doesn't report it)."""
+    try:
+        analysis = jitted.lower(*args).compile().cost_analysis()
+        if isinstance(analysis, (list, tuple)):
+            analysis = analysis[0]
+        flops = analysis.get("flops")
+        return float(flops) if flops and flops > 0 else None
+    except Exception:
+        return None
+
+
+def _peak_flops():
+    dev = jax.devices()[0]
+    for kind, peak in PEAK_FLOPS.items():
+        if dev.device_kind.startswith(kind):
+            return peak
+    return None
+
 
 def main():
     import optax
@@ -72,12 +104,25 @@ def main():
     # where the other chips sit idle)
     samples = calls * steps_per_call * batch
     sps_per_chip = samples / dt
-    print(json.dumps({
+    out = {
         "metric": "cifar10_cnn_train_samples_per_sec_per_chip",
         "value": round(sps_per_chip, 1),
         "unit": "samples/sec/chip",
         "vs_baseline": round(sps_per_chip / BASELINE_SAMPLES_PER_SEC, 2),
-    }))
+    }
+    # model FLOP utilization. Cost-analyze a single-batch step (NOT the
+    # lax.scan window: XLA's cost analysis counts a loop body once,
+    # regardless of trip count) and scale by the number of steps timed.
+    from distkeras_tpu.workers import make_train_step
+
+    single = make_train_step(
+        model.apply, get_loss("categorical_crossentropy"), optimizer
+    )
+    flops = _flops_per_call(single, params, opt_state, x[0], y[0])
+    peak = _peak_flops()
+    if flops is not None and peak is not None:
+        out["mfu"] = round((flops * steps_per_call * calls / dt) / peak, 4)
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -62,6 +62,9 @@ class Trainer:
         num_epoch: int = 1,
         seed: int = 0,
         checkpointer=None,
+        metrics_path: Optional[str] = None,
+        profile_dir: Optional[str] = None,
+        stage_limit_bytes: int = 1 << 30,
     ):
         self.model = model
         self.params = params
@@ -75,6 +78,17 @@ class Trainer:
         self.num_epoch = num_epoch
         self.seed = seed
         self.checkpointer = checkpointer
+        # data bigger than this budget is streamed instead of staged
+        # resident on-device (applies to workers and the SPMD epoch path)
+        self.stage_limit_bytes = stage_limit_bytes
+        # observability (SURVEY.md §5.1/§5.5 — absent in the reference):
+        # metrics_path= writes per-step JSONL via MetricsWriter;
+        # profile_dir= wraps the hot loop in a jax.profiler trace
+        self.metrics_path = metrics_path
+        self.profile_dir = profile_dir
+        self.metrics_writer = None
+        self.staleness: Optional[dict] = None
+        self._trace_cm = None
         self.history: History = []
         self.executor_histories: List[History] = []
         self._t_start = None
@@ -84,9 +98,39 @@ class Trainer:
 
     def record_training_start(self):
         self._t_start = time.time()
+        if self.metrics_path is not None:
+            from distkeras_tpu.utils.metrics import MetricsWriter
+
+            self.metrics_writer = MetricsWriter(self.metrics_path)
+        if self.profile_dir is not None:
+            from distkeras_tpu.utils.profiling import trace
+
+            self._trace_cm = trace(self.profile_dir)
+            self._trace_cm.__enter__()
 
     def record_training_end(self):
         self._t_end = time.time()
+        if self._trace_cm is not None:
+            self._trace_cm.__exit__(None, None, None)
+            self._trace_cm = None
+        if self.metrics_writer is not None:
+            tp = self.metrics_writer.throughput()
+            if tp is not None:
+                self.metrics_writer.summary(
+                    "throughput", samples_per_sec=round(tp, 2),
+                    training_time=round(self.get_training_time(), 4),
+                )
+            self.metrics_writer.close()
+
+    def train(self, dataset: PartitionedDataset, shuffle: bool = False):
+        """Run training (reference: Trainer.train). The timing/trace/metrics
+        lifecycle is managed here so a failing run still stops the profiler
+        and closes the metrics file; subclasses implement :meth:`_train`."""
+        self.record_training_start()
+        try:
+            return self._train(dataset, shuffle)
+        finally:
+            self.record_training_end()
 
     def get_training_time(self) -> float:
         if self._t_start is None:
@@ -121,6 +165,7 @@ class Trainer:
             label_col=self.label_col,
             batch_size=self.batch_size,
             num_epoch=self.num_epoch,
+            stage_limit_bytes=self.stage_limit_bytes,
         )
 
     def serialize(self) -> dict:
@@ -129,7 +174,7 @@ class Trainer:
 
         return serialize_model(model_spec(self.model), self.params)
 
-    def train(self, dataset: PartitionedDataset, shuffle: bool = False) -> Model:
+    def _train(self, dataset: PartitionedDataset, shuffle: bool = False) -> Model:
         raise NotImplementedError
 
 
@@ -137,8 +182,7 @@ class SingleTrainer(Trainer):
     """Non-distributed baseline (reference: trainers.py · SingleTrainer):
     coalesce to one partition, run one sequential worker."""
 
-    def train(self, dataset: PartitionedDataset, shuffle: bool = False) -> Model:
-        self.record_training_start()
+    def _train(self, dataset: PartitionedDataset, shuffle: bool = False) -> Model:
         if shuffle:
             dataset = dataset.shuffle(seed=self.seed)
         dataset = dataset.coalesce(1)
@@ -162,6 +206,7 @@ class SingleTrainer(Trainer):
         )
         worker.num_epoch = max(0, self.num_epoch - start_epoch)
         worker.initial_opt_state = restored_opt_state
+        worker.metrics_writer = self.metrics_writer
         if self.checkpointer is not None:
             ckpt = self.checkpointer
 
@@ -176,7 +221,6 @@ class SingleTrainer(Trainer):
         params, history = worker.train(0, dataset.partition(0))
         if self.checkpointer is not None:
             self.checkpointer.wait()
-        self.record_training_end()
         self.params = params
         self.executor_histories = [history]
         self.history = history
@@ -192,8 +236,7 @@ class EnsembleTrainer(Trainer):
         super().__init__(*args, **kwargs)
         self.num_models = num_models
 
-    def train(self, dataset: PartitionedDataset, shuffle: bool = False) -> List[Model]:
-        self.record_training_start()
+    def _train(self, dataset: PartitionedDataset, shuffle: bool = False) -> List[Model]:
         if shuffle:
             dataset = dataset.shuffle(seed=self.seed)
         dataset = dataset.repartition(self.num_models)
@@ -209,11 +252,12 @@ class EnsembleTrainer(Trainer):
                 self.model, params, **self.worker_kwargs()
             ))
         workers_mod.share_compiled(workers)
+        for w in workers:
+            w.metrics_writer = self.metrics_writer
         for i, worker in enumerate(workers):
             params, history = worker.train(i, dataset.partition(i))
             models.append(Model(self.model, params))
             self.executor_histories.append(history)
-        self.record_training_end()
         return models
 
 
@@ -225,8 +269,7 @@ class AveragingTrainer(Trainer):
         super().__init__(*args, **kwargs)
         self.num_workers = num_workers
 
-    def train(self, dataset: PartitionedDataset, shuffle: bool = False) -> Model:
-        self.record_training_start()
+    def _train(self, dataset: PartitionedDataset, shuffle: bool = False) -> Model:
         if shuffle:
             dataset = dataset.shuffle(seed=self.seed)
         dataset = dataset.repartition(self.num_workers)
@@ -240,12 +283,13 @@ class AveragingTrainer(Trainer):
             for _ in range(self.num_workers)
         ]
         workers_mod.share_compiled(workers)
+        for w in workers:
+            w.metrics_writer = self.metrics_writer
         for i, worker in enumerate(workers):
             params, history = worker.train(i, dataset.partition(i))
             trained.append(params)
             self.executor_histories.append(history)
         self.params = rules.tree_mean(trained)
-        self.record_training_end()
         return Model(self.model, self.params)
 
 
@@ -301,8 +345,7 @@ class DistributedTrainer(Trainer):
     def parallelism_factor(self) -> int:
         return 1
 
-    def train(self, dataset: PartitionedDataset, shuffle: bool = False) -> Model:
-        self.record_training_start()
+    def _train(self, dataset: PartitionedDataset, shuffle: bool = False) -> Model:
         if shuffle:
             dataset = dataset.shuffle(seed=self.seed)
         n_parts = self.num_workers * self.parallelism_factor
@@ -330,6 +373,12 @@ class DistributedTrainer(Trainer):
                 restored_worker_opt = state["opt_state"]["workers"]
             except Exception:
                 restored_step, raw = self.checkpointer.restore()
+                n_saved = int(raw.get("extra", {}).get("n_workers", -1))
+                if n_saved == n_parts:
+                    # the snapshot matches this topology, so the typed
+                    # restore should have worked — a swallowed failure here
+                    # would silently drop worker momentum; stay loud
+                    raise
                 self.params = jax.tree.map(np.asarray, raw["params"])
         if self.remote_ps is not None:
             if self.checkpointer is not None:
@@ -357,6 +406,8 @@ class DistributedTrainer(Trainer):
         workers = [self.allocate_worker(i) for i in range(n_parts)]
         self.workers = workers
         workers_mod.share_compiled(workers)
+        for w in workers:
+            w.metrics_writer = self.metrics_writer
         if restored_worker_opt is not None:
             for w, s in zip(workers, restored_worker_opt):
                 w.initial_opt_state = s
@@ -401,12 +452,22 @@ class DistributedTrainer(Trainer):
             # release the closure over device-resident worker state so the
             # trainer object doesn't pin N workers' opt_state in HBM
             ps.extra_state_fn = None
+        # staleness observability (SURVEY.md §5.5): histogram of commit
+        # staleness as recorded by the PS (DynSGD populates this)
+        from distkeras_tpu.utils.metrics import staleness_histogram
+
+        log = getattr(ps, "staleness_log", None) or []
+        self.staleness = staleness_histogram(log)
+        if self.metrics_writer is not None and log:
+            self.metrics_writer.summary(
+                "staleness", histogram=self.staleness,
+                num_updates=ps.num_updates,
+            )
         if errors:
             raise errors[0]
         self.executor_histories = [h for h in results if h is not None]
         final = ps.pull() if self.remote_ps is not None else ps.get_model()
         self.params = jax.tree.map(jnp.asarray, final)
-        self.record_training_end()
         return Model(self.model, self.params)
 
 
@@ -528,8 +589,7 @@ class DataParallelTrainer(Trainer):
         super().__init__(*args, **kwargs)
         self.num_workers = num_workers
 
-    def train(self, dataset: PartitionedDataset, shuffle: bool = False) -> Model:
-        self.record_training_start()
+    def _train(self, dataset: PartitionedDataset, shuffle: bool = False) -> Model:
         if shuffle:
             dataset = dataset.shuffle(seed=self.seed)
         mesh = default_mesh(self.num_workers)
@@ -600,22 +660,57 @@ class DataParallelTrainer(Trainer):
                 params = state["params"]
                 opt_state = state["opt_state"] or opt_state
                 start_epoch = int(state["extra"].get("epoch", step))
+        # Input staging (VERDICT r1 weak #4): shard the epoch tensor over
+        # the dp axis and upload it ONCE before the epoch loop — zero
+        # host->device traffic per epoch. Datasets over the staging budget
+        # stream through in equal chunks instead (one upload per chunk per
+        # epoch, bounded residency).
+        from jax.sharding import NamedSharding
+
+        batch_sharding = NamedSharding(mesh, P(None, "dp"))
+        if xb.nbytes + yb.nbytes <= self.stage_limit_bytes:
+            chunks = [(
+                jax.device_put(xb, batch_sharding),
+                jax.device_put(yb, batch_sharding),
+            )]
+            staged = True
+        else:
+            bytes_per_batch = max(1, (xb.nbytes + yb.nbytes) // len(xb))
+            per_chunk = max(1, self.stage_limit_bytes // (2 * bytes_per_batch))
+            chunks = [
+                (xb[i:i + per_chunk], yb[i:i + per_chunk])
+                for i in range(0, len(xb), per_chunk)
+            ]
+            staged = False
+
         history: History = []
         for epoch in range(start_epoch, self.num_epoch):
-            params, opt_state, ms = sharded_epoch(
-                params, opt_state, jnp.asarray(xb), jnp.asarray(yb)
-            )
+            epoch_rows: List[dict] = []
+            for cx, cy in chunks:
+                if not staged:
+                    cx = jax.device_put(cx, batch_sharding)
+                    cy = jax.device_put(cy, batch_sharding)
+                params, opt_state, ms = sharded_epoch(params, opt_state, cx, cy)
+                ms = {k: np.asarray(v) for k, v in ms.items()}
+                epoch_rows.extend(
+                    {k: float(v[t]) for k, v in ms.items()}
+                    for t in range(len(cx))
+                )
             if self.checkpointer is not None:
                 self.checkpointer.maybe_save(
                     epoch + 1, params, opt_state,
                     extra={"epoch": epoch + 1},
                     force=(epoch + 1 == self.num_epoch),
                 )
-            ms = {k: np.asarray(v) for k, v in ms.items()}
-            for t in range(len(xb)):
-                history.append({k: float(v[t]) for k, v in ms.items()})
+            if self.metrics_writer is not None:
+                base = len(history)
+                for t, r in enumerate(epoch_rows):
+                    self.metrics_writer.log(
+                        step=base + t + 1,
+                        samples=self.batch_size * n_dev, **r,
+                    )
+            history.extend(epoch_rows)
         self.params = params
         self.history = history
         self.executor_histories = [history]
-        self.record_training_end()
         return Model(self.model, params)

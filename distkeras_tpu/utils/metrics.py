@@ -10,30 +10,45 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 import time
 from typing import Dict, List, Optional
 
 
 class MetricsWriter:
     """Append-only JSONL metrics sink with wall-clock and throughput
-    bookkeeping. Thread-safe enough for the async trainers (one writer;
-    the GIL serializes appends; flush on close)."""
+    bookkeeping. Thread-safe: async trainers share one writer across N
+    worker threads, and buffered text writes are not atomic, so appends
+    take a lock."""
 
     def __init__(self, path: Optional[str] = None):
         self.path = path
         self._records: List[dict] = []
         self._fh = open(path, "a") if path else None
         self._t0 = time.time()
+        self._lock = threading.Lock()
 
-    def log(self, step: int, samples: Optional[int] = None, **scalars):
+    def log(self, step: int, samples: Optional[int] = None,
+            worker: Optional[int] = None, **scalars):
         rec = {"step": int(step), "t": round(time.time() - self._t0, 6)}
         if samples is not None:
             rec["samples"] = int(samples)
+        if worker is not None:
+            rec["worker"] = int(worker)
         for k, v in scalars.items():
             rec[k] = float(v)
-        self._records.append(rec)
-        if self._fh:
-            self._fh.write(json.dumps(rec) + "\n")
+        self._append(rec)
+
+    def summary(self, kind: str, **fields):
+        """Write a non-step summary record (e.g. a staleness histogram or
+        final throughput) as its own JSON line."""
+        self._append({"kind": kind, **fields})
+
+    def _append(self, rec: dict):
+        with self._lock:
+            self._records.append(rec)
+            if self._fh:
+                self._fh.write(json.dumps(rec) + "\n")
 
     @property
     def records(self) -> List[dict]:

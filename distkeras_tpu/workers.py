@@ -157,6 +157,7 @@ class Worker:
         batch_size: int = 32,
         num_epoch: int = 1,
         device=None,
+        stage_limit_bytes: int = 1 << 30,
     ):
         self.module = module
         self.params = params
@@ -176,7 +177,23 @@ class Worker:
         # and kept resident (zero re-upload across epochs/windows); bigger
         # ones are staged window-by-window so a partition larger than free
         # HBM still trains.
-        self.stage_limit_bytes = 1 << 30
+        self.stage_limit_bytes = stage_limit_bytes
+        # optional MetricsWriter installed by the trainer; workers stream
+        # per-step records into it as they complete windows
+        self.metrics_writer = None
+        self.index = 0
+        self._step_count = 0
+
+    def _log_steps(self, records: Sequence[Dict[str, float]]):
+        """Stream freshly-completed step records to the metrics writer."""
+        w = self.metrics_writer
+        if w is not None:
+            for r in records:
+                self._step_count += 1
+                w.log(step=self._step_count, samples=self.batch_size,
+                      worker=self.index, **r)
+        else:
+            self._step_count += len(records)
 
     def _put(self, tree):
         """Move a pytree onto this worker's device (committed), or just
@@ -231,6 +248,7 @@ class SequentialWorker(Worker):
 
     def train(self, index: int, partition) -> Tuple[object, History]:
         self.prepare()
+        self.index = index
         xb, yb = self.batches(partition)
         # one host->device upload for the whole run when it fits HBM
         # (else per-epoch upload, the pre-staging behavior)
@@ -245,8 +263,11 @@ class SequentialWorker(Worker):
                 params, opt_state, xb_d, yb_d
             )
             ms = {k: np.asarray(v) for k, v in ms.items()}
-            for t in range(len(xb)):
-                history.append({k: float(v[t]) for k, v in ms.items()})
+            epoch_rows = [
+                {k: float(v[t]) for k, v in ms.items()} for t in range(len(xb))
+            ]
+            history.extend(epoch_rows)
+            self._log_steps(epoch_rows)
             if callback is not None:
                 callback(epoch, params, opt_state)
         self.params = params
@@ -280,6 +301,7 @@ class WindowedWorker(Worker):
 
     def train(self, index: int, partition, ps) -> Tuple[object, History]:
         self.prepare()
+        self.index = index
         self.on_start(index, ps)
         xb, yb = self.batches(partition)
         # whole partition resident on-device when it fits (windows slice
@@ -302,9 +324,14 @@ class WindowedWorker(Worker):
                     )
                     self.params, self.opt_state = params, opt_state
                     ms = {k: np.asarray(v) for k, v in ms.items()}
-                    for t in range(stop - start):
-                        history.append({k: float(v[t]) for k, v in ms.items()})
+                    rows = [
+                        {k: float(v[t]) for k, v in ms.items()}
+                        for t in range(stop - start)
+                    ]
+                    history.extend(rows)
+                    self._log_steps(rows)
                 else:
+                    rows = []
                     for b in range(start, stop):
                         xw, yw = xb[b], yb[b]
                         if not staged:
@@ -312,7 +339,9 @@ class WindowedWorker(Worker):
                         self.params, self.opt_state, m = self.step(
                             self.params, self.opt_state, xw, yw,
                         )
-                        history.append({k: float(v) for k, v in m.items()})
+                        rows.append({k: float(v) for k, v in m.items()})
+                    history.extend(rows)
+                    self._log_steps(rows)
                 self.on_round(index, ps)
                 start = stop
         return self.params, history
