@@ -38,8 +38,12 @@ def sinusoidal_positions(max_len: int, dim: int) -> np.ndarray:
 class CausalSelfAttention(nn.Module):
     num_heads: int
     dtype: jnp.dtype = jnp.bfloat16
-    attention: str = "standard"  # 'standard' | 'ring'
+    # 'standard' (blocked above _DENSE_MAX_T, dense below), 'blocked',
+    # 'dense', or 'ring' (sequence-parallel over seq_axis)
+    attention: str = "standard"
     seq_axis: str = "sp"  # mesh axis name used when attention == 'ring'
+
+    _DENSE_MAX_T = 512  # short sequences: one fused dense block is fastest
 
     @nn.compact
     def __call__(self, x):
@@ -48,11 +52,18 @@ class CausalSelfAttention(nn.Module):
         hd = D // H
         qkv = nn.DenseGeneral((3, H, hd), dtype=self.dtype, name="qkv")(x)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # [B, T, H, hd]
-        if self.attention == "ring":
+        mode = self.attention
+        if mode == "standard":
+            mode = "dense" if T <= self._DENSE_MAX_T else "blocked"
+        if mode == "ring":
             from distkeras_tpu.ops.ring_attention import ring_attention
 
             out = ring_attention(q, k, v, axis_name=self.seq_axis, causal=True)
-        else:
+        elif mode == "blocked":
+            from distkeras_tpu.ops.flash_attention import blocked_causal_attention
+
+            out = blocked_causal_attention(q, k, v, causal=True)
+        elif mode == "dense":
             scale = 1.0 / np.sqrt(hd)
             logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
             mask = jnp.tril(jnp.ones((T, T), dtype=bool))
@@ -60,6 +71,11 @@ class CausalSelfAttention(nn.Module):
             probs = jnp.exp(logits - logits.max(-1, keepdims=True))
             probs = probs / probs.sum(-1, keepdims=True)
             out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(self.dtype), v)
+        else:
+            raise ValueError(
+                f"Unknown attention mode '{self.attention}'. "
+                "Known: standard, dense, blocked, ring"
+            )
         return nn.DenseGeneral(D, axis=(-2, -1), dtype=self.dtype, name="out")(out)
 
 

@@ -105,8 +105,11 @@ class Trainer:
         if self.profile_dir is not None:
             from distkeras_tpu.utils.profiling import trace
 
-            self._trace_cm = trace(self.profile_dir)
-            self._trace_cm.__enter__()
+            cm = trace(self.profile_dir)
+            cm.__enter__()
+            # assign only after a successful enter so a failed start never
+            # makes record_training_end stop a trace that isn't running
+            self._trace_cm = cm
 
     def record_training_end(self):
         self._t_end = time.time()
@@ -126,8 +129,8 @@ class Trainer:
         """Run training (reference: Trainer.train). The timing/trace/metrics
         lifecycle is managed here so a failing run still stops the profiler
         and closes the metrics file; subclasses implement :meth:`_train`."""
-        self.record_training_start()
         try:
+            self.record_training_start()
             return self._train(dataset, shuffle)
         finally:
             self.record_training_end()
@@ -227,7 +230,117 @@ class SingleTrainer(Trainer):
         return Model(self.model, params)
 
 
-class EnsembleTrainer(Trainer):
+class _StackedModelTrainer(Trainer):
+    """Shared machinery for EnsembleTrainer / AveragingTrainer: train k
+    independent models as ONE stacked program.
+
+    The reference ran its k sequential workers concurrently on k Spark
+    executors; the serial-loop equivalent here would leave (k-1)/k of the
+    machine idle. TPU-native redesign (SURVEY.md §2 "cheap on TPU: vmapped
+    per-device independent models"): stack the k models' params on a
+    leading axis, ``vmap`` the epoch scan over it, and shard that axis
+    over a ``model`` device mesh — k models train in one XLA dispatch per
+    epoch with zero cross-model synchronization.
+    """
+
+    def _stacked_train(self, dataset: PartitionedDataset, k: int,
+                       param_seeds: Sequence[int], shuffle: bool,
+                       common_init: Optional[Any] = None):
+        from jax.sharding import Mesh, NamedSharding
+
+        if shuffle:
+            dataset = dataset.shuffle(seed=self.seed)
+        dataset = dataset.repartition(k)
+
+        optimizer = get_optimizer(self.worker_optimizer, self.learning_rate)
+        loss_fn = get_loss(self.loss)
+        metric_fns = resolve_metrics(self.metrics)
+        apply_fn = self.model.apply
+
+        if common_init is not None:
+            plist = [common_init] * k
+        else:
+            plist = []
+            for i in range(k):
+                x = dataset.partition(i)[self.features_col][:1]
+                plist.append(self.model.init(
+                    jax.random.PRNGKey(param_seeds[i]), jnp.asarray(x)
+                ))
+        params = jax.tree.map(lambda *xs: jnp.stack(xs), *plist)
+        opt_state = jax.vmap(optimizer.init)(params)
+
+        xs, ys = [], []
+        for i in range(k):
+            xb, yb = workers_mod.batch_partition(
+                dataset.partition(i), self.features_col, self.label_col,
+                self.batch_size,
+            )
+            xs.append(xb)
+            ys.append(yb)
+        # models advance in lockstep inside one program: truncate to the
+        # shortest partition's batch count (repartition splits near-equally,
+        # so at most one trailing batch per model is dropped)
+        nb = min(len(x) for x in xs)
+        xb = np.stack([x[:nb] for x in xs])
+        yb = np.stack([y[:nb] for y in ys])
+
+        # one model's epoch is exactly a communication window of its whole
+        # batch list — reuse the canonical step math so ensemble/averaging
+        # can never diverge from the worker path
+        window = workers_mod.make_window_step(
+            apply_fn, loss_fn, optimizer, metric_fns
+        )
+        vepoch = jax.jit(jax.vmap(window))
+
+        # shard the model axis over as many devices as divide k
+        ndev = len(jax.devices())
+        m = max(d for d in range(1, min(k, ndev) + 1) if k % d == 0)
+        sh = None
+        if m > 1:
+            mesh = Mesh(np.asarray(jax.devices()[:m]), ("model",))
+            sh = NamedSharding(mesh, P("model"))
+            params = jax.device_put(params, sh)
+            opt_state = jax.device_put(opt_state, sh)
+
+        def put(x):
+            return jax.device_put(x, sh) if sh is not None else jnp.asarray(x)
+
+        # stage the stacked epoch tensors resident once when they fit the
+        # budget; else re-upload per epoch (bounded-memory fallback)
+        staged = xb.nbytes + yb.nbytes <= self.stage_limit_bytes
+        if staged:
+            xb, yb = put(xb), put(yb)
+
+        histories: List[History] = [[] for _ in range(k)]
+        for _epoch in range(self.num_epoch):
+            xe, ye = (xb, yb) if staged else (put(xb), put(yb))
+            params, opt_state, ms = vepoch(params, opt_state, xe, ye)
+            ms = {key: np.asarray(v) for key, v in ms.items()}
+            for i in range(k):
+                rows = [
+                    {key: float(v[i, t]) for key, v in ms.items()}
+                    for t in range(nb)
+                ]
+                if self.metrics_writer is not None:
+                    base = len(histories[i])
+                    for t, r in enumerate(rows):
+                        self.metrics_writer.log(
+                            step=base + t + 1, samples=self.batch_size,
+                            worker=i, **r,
+                        )
+                histories[i].extend(rows)
+        self.executor_histories = histories
+        return params
+
+    @staticmethod
+    def _unstack(params, k: int):
+        return [
+            jax.tree.map(lambda x, i=i: np.asarray(x[i]), params)
+            for i in range(k)
+        ]
+
+
+class EnsembleTrainer(_StackedModelTrainer):
     """Train k independent models on k partitions (reference: trainers.py ·
     EnsembleTrainer). Returns a list of Models; each starts from a
     differently-seeded init."""
@@ -237,31 +350,14 @@ class EnsembleTrainer(Trainer):
         self.num_models = num_models
 
     def _train(self, dataset: PartitionedDataset, shuffle: bool = False) -> List[Model]:
-        if shuffle:
-            dataset = dataset.shuffle(seed=self.seed)
-        dataset = dataset.repartition(self.num_models)
-        models: List[Model] = []
-        self.executor_histories = []
-        workers = []
-        for i in range(self.num_models):
-            x = dataset.partition(i)[self.features_col][:1]
-            params = self.model.init(
-                jax.random.PRNGKey(self.seed + i), jnp.asarray(x)
-            )
-            workers.append(workers_mod.SequentialWorker(
-                self.model, params, **self.worker_kwargs()
-            ))
-        workers_mod.share_compiled(workers)
-        for w in workers:
-            w.metrics_writer = self.metrics_writer
-        for i, worker in enumerate(workers):
-            params, history = worker.train(i, dataset.partition(i))
-            models.append(Model(self.model, params))
-            self.executor_histories.append(history)
-        return models
+        k = self.num_models
+        stacked = self._stacked_train(
+            dataset, k, [self.seed + i for i in range(k)], shuffle
+        )
+        return [Model(self.model, p) for p in self._unstack(stacked, k)]
 
 
-class AveragingTrainer(Trainer):
+class AveragingTrainer(_StackedModelTrainer):
     """One-shot parameter averaging (reference: trainers.py ·
     AveragingTrainer): train per-partition from a common init, average."""
 
@@ -270,26 +366,12 @@ class AveragingTrainer(Trainer):
         self.num_workers = num_workers
 
     def _train(self, dataset: PartitionedDataset, shuffle: bool = False) -> Model:
-        if shuffle:
-            dataset = dataset.shuffle(seed=self.seed)
-        dataset = dataset.repartition(self.num_workers)
-        self.ensure_params(dataset)
-        trained = []
-        self.executor_histories = []
-        workers = [
-            workers_mod.SequentialWorker(
-                self.model, self.params, **self.worker_kwargs()
-            )
-            for _ in range(self.num_workers)
-        ]
-        workers_mod.share_compiled(workers)
-        for w in workers:
-            w.metrics_writer = self.metrics_writer
-        for i, worker in enumerate(workers):
-            params, history = worker.train(i, dataset.partition(i))
-            trained.append(params)
-            self.executor_histories.append(history)
-        self.params = rules.tree_mean(trained)
+        k = self.num_workers
+        stacked = self._stacked_train(
+            dataset, k, [self.seed] * k, shuffle, common_init=self.params
+        )
+        # one-shot average over the model axis
+        self.params = jax.tree.map(lambda x: np.asarray(x).mean(axis=0), stacked)
         return Model(self.model, self.params)
 
 
