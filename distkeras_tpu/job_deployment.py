@@ -52,6 +52,13 @@ class Job:
         self.ps_port = ps_port
         self.env = dict(env or {})
         self.python = python
+        # every job gets a shared secret for the PS transport unless the
+        # caller provided one — the auto-wired multi-host service binds a
+        # routable interface, so it must never come up unauthenticated
+        if "DK_TPU_SECRET" not in self.env:
+            import secrets
+
+            self.env["DK_TPU_SECRET"] = secrets.token_hex(16)
 
     # -- command construction (separated for testability) -------------------
 

@@ -94,6 +94,12 @@ def native_transport_active() -> bool:
 # Framing (reference: send_data / recv_data)
 # ---------------------------------------------------------------------------
 
+# Upper bound on an accepted frame. Without it an 8-byte length header can
+# demand an allocation up to INT64_MAX before any payload arrives (ADVICE
+# r1). Big enough for multi-GB model pytrees; raise explicitly if needed.
+MAX_FRAME_BYTES = 1 << 33  # 8 GiB
+
+
 def send_frame(sock: socket.socket, payload: bytes):
     lib = _load_native()
     if lib:
@@ -104,13 +110,20 @@ def send_frame(sock: socket.socket, payload: bytes):
         sock.sendall(struct.pack(">Q", len(payload)) + payload)
 
 
-def recv_frame(sock: socket.socket) -> Optional[bytes]:
-    """One frame, or None on clean EOF."""
+def recv_frame(
+    sock: socket.socket, max_bytes: int = MAX_FRAME_BYTES
+) -> Optional[bytes]:
+    """One frame, or None on clean EOF. Frames over ``max_bytes`` raise
+    (and the caller should drop the connection) instead of allocating."""
     lib = _load_native()
     if lib:
         size = lib.dk_recv_frame_size(sock.fileno())
         if size < 0:
             return None
+        if size > max_bytes:
+            raise ConnectionError(
+                f"frame of {size} bytes exceeds max_bytes={max_bytes}"
+            )
         buf = ctypes.create_string_buffer(size)
         if lib.dk_recv_exact(sock.fileno(), buf, size) != 0:
             return None
@@ -119,6 +132,10 @@ def recv_frame(sock: socket.socket) -> Optional[bytes]:
     if header is None:
         return None
     (size,) = struct.unpack(">Q", header)
+    if size > max_bytes:
+        raise ConnectionError(
+            f"frame of {size} bytes exceeds max_bytes={max_bytes}"
+        )
     return _recv_exact_py(sock, size)
 
 
@@ -139,8 +156,8 @@ def send_msg(sock: socket.socket, obj: Any):
     send_frame(sock, flax_serialization.msgpack_serialize(obj))
 
 
-def recv_msg(sock: socket.socket) -> Any:
-    data = recv_frame(sock)
+def recv_msg(sock: socket.socket, max_bytes: int = MAX_FRAME_BYTES) -> Any:
+    data = recv_frame(sock, max_bytes=max_bytes)
     if data is None:
         return None
     return flax_serialization.msgpack_restore(data)
@@ -175,16 +192,35 @@ def connect(host: str, port: int, disable_nagle: bool = True) -> socket.socket:
 class ParameterServerService:
     """Expose a :class:`~distkeras_tpu.parameter_servers.ParameterServer`
     over TCP (reference: parameter_servers.py · SocketParameterServer's
-    accept loop + per-connection handler threads)."""
+    accept loop + per-connection handler threads).
 
-    def __init__(self, ps, host: str = "0.0.0.0", port: int = 0):
+    Hardening over the reference (ADVICE r1): binds loopback unless an
+    explicit host is given, supports a shared-secret handshake (clients
+    must open with ``{"op": "auth", "token": ...}`` when ``secret`` is
+    set), caps frame sizes, replies ``{"error": ...}`` on per-op failures
+    instead of dropping the connection, and prunes finished handler
+    threads.
+    """
+
+    def __init__(self, ps, host: str = "127.0.0.1", port: int = 0,
+                 secret: Optional[str] = None,
+                 max_frame_bytes: int = MAX_FRAME_BYTES):
         self.ps = ps
+        self.secret = secret
+        self.max_frame_bytes = max_frame_bytes
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
         self.port = self._sock.getsockname()[1]
         self._threads = []
         self._running = False
+        # workers on other processes announce completion with 'leave';
+        # a remote PROCESS announces it is fully done (final center read)
+        # with a negative-id leave. The owner waits for the latter before
+        # tearing the service down.
+        self.remote_leaves = 0
+        self.remote_done = 0
+        self._leave_cond = threading.Condition()
 
     def start(self):
         self._running = True
@@ -204,47 +240,84 @@ class ParameterServerService:
                 target=self._handle, args=(conn,), daemon=True
             )
             t.start()
+            self._threads = [x for x in self._threads if x.is_alive()]
             self._threads.append(t)
 
     def _handle(self, conn: socket.socket):
         """Per-connection dispatch (reference: the 1-byte 'c'/'p' action
         protocol, upgraded to named ops)."""
+        authed = self.secret is None
         try:
             while True:
                 try:
-                    msg = recv_msg(conn)
-                except Exception:  # malformed frame: drop this client only
+                    msg = recv_msg(conn, max_bytes=self.max_frame_bytes)
+                except Exception:  # malformed/oversized: drop this client
                     return
                 if msg is None or not isinstance(msg, dict):
                     return
                 op = msg.get("op")
-                if op == "pull":
-                    send_msg(conn, {"value": self.ps.pull()})
-                elif op == "pull_with_clock":
-                    value, clock = self.ps.pull_with_clock()
-                    send_msg(conn, {"value": value, "clock": clock})
-                elif op == "commit":
-                    self.ps.commit(
-                        msg["delta"], worker=int(msg.get("worker", 0)),
-                        worker_clock=int(msg.get("clock", 0)),
-                    )
-                    send_msg(conn, {"ok": 1})
-                elif op == "commit_and_wait":
-                    center = self.ps.commit_and_wait(
-                        msg["params"], worker=int(msg.get("worker", 0))
-                    )
-                    send_msg(conn, {"value": center})
-                elif op == "leave":
-                    self.ps.leave(int(msg.get("worker", 0)))
-                    send_msg(conn, {"ok": 1})
-                elif op == "num_updates":
-                    send_msg(conn, {"value": self.ps.num_updates})
-                else:
-                    send_msg(conn, {"error": f"unknown op {op!r}"})
+                if not authed:
+                    if op == "auth" and str(msg.get("token")) == self.secret:
+                        authed = True
+                        send_msg(conn, {"ok": 1})
+                        continue
+                    send_msg(conn, {"error": "auth required"})
+                    return
+                try:
+                    self._dispatch(conn, op, msg)
+                except (ConnectionError, OSError):
+                    raise
+                except Exception as e:  # op failure: reply, keep serving
+                    send_msg(conn, {"error": f"{type(e).__name__}: {e}"})
         except (ConnectionError, OSError):
             return
         finally:
             conn.close()
+
+    def _dispatch(self, conn: socket.socket, op, msg: dict):
+        if op == "pull":
+            send_msg(conn, {"value": self.ps.pull()})
+        elif op == "pull_with_clock":
+            value, clock = self.ps.pull_with_clock()
+            send_msg(conn, {"value": value, "clock": clock})
+        elif op == "commit":
+            self.ps.commit(
+                msg["delta"], worker=int(msg.get("worker", 0)),
+                worker_clock=int(msg.get("clock", 0)),
+            )
+            send_msg(conn, {"ok": 1})
+        elif op == "commit_and_wait":
+            center = self.ps.commit_and_wait(
+                msg["params"], worker=int(msg.get("worker", 0))
+            )
+            send_msg(conn, {"value": center})
+        elif op == "leave":
+            wid = int(msg.get("worker", 0))
+            if wid < 0:
+                # process-level done sentinel: the remote process has read
+                # its final center and will make no further calls
+                with self._leave_cond:
+                    self.remote_done += 1
+                    self._leave_cond.notify_all()
+            else:
+                self.ps.leave(wid)
+                with self._leave_cond:
+                    self.remote_leaves += 1
+                    self._leave_cond.notify_all()
+            send_msg(conn, {"ok": 1})
+        elif op == "num_updates":
+            send_msg(conn, {"value": self.ps.num_updates})
+        else:
+            send_msg(conn, {"error": f"unknown op {op!r}"})
+
+    def wait_for_remote_done(self, count: int, timeout: float = 600.0) -> bool:
+        """Block until ``count`` remote PROCESSES have announced they are
+        fully done (final center read) — the owner calls this before
+        stopping the service so no process loses the center mid-exchange."""
+        with self._leave_cond:
+            return self._leave_cond.wait_for(
+                lambda: self.remote_done >= count, timeout=timeout
+            )
 
     def stop(self):
         self._running = False
@@ -259,15 +332,42 @@ class RemoteParameterServer:
     :class:`ParameterServer`, so workers are transport-agnostic
     (reference: workers.py · NetworkWorker.connect/pull/push)."""
 
-    def __init__(self, host: str, port: int):
+    def __init__(self, host: str, port: int, secret: Optional[str] = None,
+                 connect_timeout: float = 120.0):
         self.host, self.port = host, port
+        self.secret = secret
+        # processes come up skewed (the owner may still be compiling when
+        # a peer's first worker pulls) — retry refused connections until
+        # the service is listening
+        self.connect_timeout = connect_timeout
         self._local = threading.local()
+
+    def _connect_with_retry(self) -> socket.socket:
+        import time
+
+        deadline = time.monotonic() + self.connect_timeout
+        while True:
+            try:
+                return connect(self.host, self.port)
+            except (ConnectionRefusedError, OSError):
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.2)
 
     def _sock(self) -> socket.socket:
         # one connection per worker thread, mirroring the reference's
         # per-executor connection
         if not hasattr(self._local, "sock"):
-            self._local.sock = connect(self.host, self.port)
+            sock = self._connect_with_retry()
+            if self.secret is not None:
+                send_msg(sock, {"op": "auth", "token": self.secret})
+                reply = recv_msg(sock)
+                if not (isinstance(reply, dict) and reply.get("ok")):
+                    sock.close()
+                    raise ConnectionError(
+                        "parameter server rejected auth handshake"
+                    )
+            self._local.sock = sock
         return self._local.sock
 
     def _call(self, msg: dict) -> dict:

@@ -1,0 +1,122 @@
+"""Multi-host runtime bootstrap.
+
+Reference: the reference had no multi-host runtime of its own — Spark
+provided the process topology and distkeras/job_deployment.py · Job merely
+ssh'd `spark-submit` at it. Here the topology is explicit: `Job`
+(:mod:`distkeras_tpu.job_deployment`) exports ``DK_TPU_*`` environment
+variables and this module consumes them — :func:`initialize` reads the
+process's coordinates, optionally calls :func:`jax.distributed.initialize`
+(required for multi-host SPMD over DCN), and records where the async
+parameter-server service lives so :class:`DistributedTrainer` can
+auto-wire itself: the coordinator process owns the center and serves it
+(:class:`~distkeras_tpu.networking.ParameterServerService`); every other
+process contributes workers through a
+:class:`~distkeras_tpu.networking.RemoteParameterServer` proxy
+(async-over-DCN, SURVEY.md §5.8).
+
+Environment contract (written by ``Job.environment_for``):
+
+- ``DK_TPU_COORDINATOR``   host:port for jax.distributed's coordinator
+- ``DK_TPU_PROCESS_ID``    this process's rank
+- ``DK_TPU_NUM_PROCESSES`` world size
+- ``DK_TPU_PS_ADDRESS``    host:port of the parameter-server service
+- ``DK_TPU_SECRET``        optional shared secret for the PS transport
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+
+@dataclass
+class RuntimeContext:
+    process_id: int
+    num_processes: int
+    coordinator: str  # host:port
+    ps_address: str  # host:port
+    secret: Optional[str] = None
+
+    @property
+    def is_coordinator(self) -> bool:
+        return self.process_id == 0
+
+    @property
+    def ps_hostport(self) -> Tuple[str, int]:
+        host, port = self.ps_address.rsplit(":", 1)
+        return host, int(port)
+
+
+_context: Optional[RuntimeContext] = None
+_jax_dist_initialized = False
+
+
+def current() -> Optional[RuntimeContext]:
+    """The active runtime context, or None when running single-host."""
+    return _context
+
+
+def initialize(
+    init_jax_distributed: bool = True,
+    process_id: Optional[int] = None,
+    num_processes: Optional[int] = None,
+    coordinator: Optional[str] = None,
+    ps_address: Optional[str] = None,
+) -> Optional[RuntimeContext]:
+    """Read the ``DK_TPU_*`` environment (or explicit overrides), remember
+    the topology, and — for true multi-process runs — bring up JAX's
+    distributed runtime so SPMD programs can span hosts.
+
+    Idempotent: repeat calls return the existing context. Returns None when
+    no multi-process environment is configured (plain single-host run).
+    """
+    global _context, _jax_dist_initialized
+    if _context is not None:
+        return _context
+
+    env = os.environ
+    num = num_processes if num_processes is not None else int(
+        env.get("DK_TPU_NUM_PROCESSES", "1")
+    )
+    pid = process_id if process_id is not None else int(
+        env.get("DK_TPU_PROCESS_ID", "0")
+    )
+    coord = coordinator or env.get("DK_TPU_COORDINATOR", "")
+    ps = ps_address or env.get("DK_TPU_PS_ADDRESS", "")
+    if num <= 1:
+        return None
+    if not coord or not ps:
+        raise ValueError(
+            "multi-process run needs DK_TPU_COORDINATOR and "
+            "DK_TPU_PS_ADDRESS (launch via distkeras_tpu.job_deployment.Job "
+            "or export them explicitly)"
+        )
+    _context = RuntimeContext(
+        process_id=pid,
+        num_processes=num,
+        coordinator=coord,
+        ps_address=ps,
+        secret=env.get("DK_TPU_SECRET") or None,
+    )
+    if init_jax_distributed and not _jax_dist_initialized:
+        import jax
+
+        jax.distributed.initialize(
+            coordinator_address=coord,
+            num_processes=num,
+            process_id=pid,
+        )
+        _jax_dist_initialized = True
+    return _context
+
+
+def shutdown():
+    """Tear down the runtime context (tests / repeated in-process runs)."""
+    global _context, _jax_dist_initialized
+    if _jax_dist_initialized:
+        import jax
+
+        jax.distributed.shutdown()
+        _jax_dist_initialized = False
+    _context = None

@@ -428,11 +428,29 @@ class DistributedTrainer(Trainer):
         return 1
 
     def _train(self, dataset: PartitionedDataset, shuffle: bool = False) -> Model:
+        from distkeras_tpu import runtime
+
         if shuffle:
             dataset = dataset.shuffle(seed=self.seed)
         n_parts = self.num_workers * self.parallelism_factor
         dataset = dataset.repartition(n_parts)
         self.ensure_params(dataset)
+
+        # Topology: single-process (own the center in-process), explicit
+        # remote_ps client, or auto-wired multi-host via the runtime
+        # context — coordinator owns the center and serves it over DCN,
+        # everyone else proxies (SURVEY.md §5.8 async-over-DCN).
+        ctx = runtime.current()
+        multihost = ctx is not None and ctx.num_processes > 1
+        is_owner = self.remote_ps is None and (not multihost or ctx.is_coordinator)
+        worker_offset = ctx.process_id * n_parts if multihost else 0
+        if self.checkpointer is not None and not is_owner:
+            raise ValueError(
+                "checkpointer must live with the process that owns the "
+                "center (the coordinator / ParameterServerService host), "
+                "not a remote client — pass it there instead"
+            )
+
         restored_worker_opt = None
         restored_step = 0
         if self.checkpointer is not None and self.checkpointer.latest_step is not None:
@@ -462,23 +480,41 @@ class DistributedTrainer(Trainer):
                     # would silently drop worker momentum; stay loud
                     raise
                 self.params = jax.tree.map(np.asarray, raw["params"])
+        service = None
         if self.remote_ps is not None:
-            if self.checkpointer is not None:
-                raise ValueError(
-                    "checkpointer must live with the process that owns the "
-                    "center (the ParameterServerService host), not a "
-                    "remote_ps client — pass it there instead"
-                )
             from distkeras_tpu.networking import RemoteParameterServer
 
             ps = RemoteParameterServer(*self.remote_ps)
+        elif multihost and not ctx.is_coordinator:
+            from distkeras_tpu.networking import RemoteParameterServer
+
+            ps = RemoteParameterServer(*ctx.ps_hostport, secret=ctx.secret)
         else:
-            ps = self.allocate_parameter_server()
+            if multihost:
+                # PS math that divides by the worker population (ADAG
+                # normalization, the EASGD round barrier) must see the
+                # GLOBAL count, not this process's share. Set only for this
+                # allocation — a stale global count would deadlock a later
+                # single-host run of the same trainer object.
+                self._ps_num_workers = self.num_workers * ctx.num_processes
+            try:
+                ps = self.allocate_parameter_server()
+            finally:
+                self.__dict__.pop("_ps_num_workers", None)
             ps.checkpointer = self.checkpointer
             # continue save steps past the restored run's so a resumed
             # run's snapshots never collide with (and get skipped against)
             # the prior run's steps
             ps.step_offset = restored_step
+            if multihost:
+                from distkeras_tpu.networking import ParameterServerService
+
+                host, port = ctx.ps_hostport
+                bind = "0.0.0.0" if host not in ("127.0.0.1", "localhost") else host
+                service = ParameterServerService(
+                    ps, host=bind, port=port, secret=ctx.secret
+                )
+                service.start()
         self.parameter_server = ps
         ps.start()
 
@@ -493,7 +529,7 @@ class DistributedTrainer(Trainer):
         if restored_worker_opt is not None:
             for w, s in zip(workers, restored_worker_opt):
                 w.initial_opt_state = s
-        if self.checkpointer is not None and self.remote_ps is None:
+        if self.checkpointer is not None and is_owner:
             fallback_opt = workers[0].optimizer.init(self.params)
 
             def _worker_states():
@@ -508,14 +544,15 @@ class DistributedTrainer(Trainer):
             ps.extra_state_fn = _worker_states
 
         def run(i: int):
+            gi = worker_offset + i  # globally-unique worker id
             try:
-                _, history = workers[i].train(i, dataset.partition(i), ps)
+                _, history = workers[i].train(gi, dataset.partition(i), ps)
                 results[i] = history
             except BaseException as e:  # surface worker failures to driver
                 errors.append(e)
             finally:
                 # shrink any synchronous barrier so survivors never deadlock
-                ps.leave(i)
+                ps.leave(gi)
 
         threads = [threading.Thread(target=run, args=(i,), daemon=True)
                    for i in range(n_parts)]
@@ -523,8 +560,39 @@ class DistributedTrainer(Trainer):
             t.start()
         for t in threads:
             t.join()
+        if is_owner:
+            if service is not None and not errors:
+                # other processes are still training against our center —
+                # wait until each has read its final center before teardown
+                done = service.wait_for_remote_done(ctx.num_processes - 1)
+                if not done:
+                    import warnings
+
+                    warnings.warn(
+                        "timed out waiting for remote processes to read the "
+                        "final center — a peer likely died; the returned "
+                        "model reflects all commits received so far",
+                        RuntimeWarning,
+                    )
+            final = ps.get_model()
+        elif errors:
+            # a local worker failed: skip the final pull (it could hang on
+            # a dead coordinator) but still send the done sentinel — it
+            # only means "no further calls from this process", and without
+            # it the owner would block out its full teardown timeout. The
+            # failure itself surfaces via this process's nonzero exit
+            # (Job.run raises) and the raise below.
+            ps.leave(-1 - worker_offset)
+            final = None
+        else:
+            # read the final center, then tell the owner this process is
+            # completely done (negative-id leave = process-done sentinel)
+            final = ps.pull()
+            ps.leave(-1 - worker_offset)
         ps.stop()
-        if self.checkpointer is not None and self.remote_ps is None:
+        if service is not None:
+            service.stop()
+        if self.checkpointer is not None and is_owner:
             opt_state, extra = ps.extra_state_fn()
             self.checkpointer.maybe_save(
                 ps.step_offset + ps.num_updates, ps.get_model(),
@@ -548,7 +616,6 @@ class DistributedTrainer(Trainer):
         if errors:
             raise errors[0]
         self.executor_histories = [h for h in results if h is not None]
-        final = ps.pull() if self.remote_ps is not None else ps.get_model()
         self.params = jax.tree.map(jnp.asarray, final)
         return Model(self.model, self.params)
 
@@ -582,7 +649,10 @@ class ADAG(AsynchronousDistributedTrainer):
     WORKER_CLS = workers_mod.ADAGWorker
 
     def allocate_parameter_server(self):
-        return ps_mod.ADAGParameterServer(self.params, self.num_workers)
+        # _ps_num_workers is the global population under multi-host runs
+        return ps_mod.ADAGParameterServer(
+            self.params, getattr(self, "_ps_num_workers", self.num_workers)
+        )
 
 
 class DynSGD(AsynchronousDistributedTrainer):
@@ -619,6 +689,12 @@ class EAMSGD(AEASGD):
     WORKER_CLS = workers_mod.EAMSGDWorker
 
     def __init__(self, *args, momentum: float = 0.9, **kwargs):
+        if kwargs.get("worker_optimizer", "sgd") != "sgd":
+            raise ValueError(
+                "EAMSGD defines its own worker optimizer (Nesterov SGD with "
+                "the `momentum` knob); a custom worker_optimizer would be "
+                "silently ignored — use AEASGD if you need one"
+            )
         super().__init__(*args, **kwargs)
         self.momentum = momentum
         # Build the Nesterov-momentum optimizer concretely so the momentum
@@ -650,8 +726,8 @@ class EASGD(SynchronousDistributedTrainer):
 
     def allocate_parameter_server(self):
         return ps_mod.EASGDParameterServer(
-            self.params, self.num_workers, rho=self.rho,
-            elastic_lr=self.elastic_lr,
+            self.params, getattr(self, "_ps_num_workers", self.num_workers),
+            rho=self.rho, elastic_lr=self.elastic_lr,
         )
 
 

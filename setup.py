@@ -18,11 +18,14 @@ setup(
     packages=find_packages(include=["distkeras_tpu", "distkeras_tpu.*"]),
     python_requires=">=3.10",
     install_requires=[
-        "jax",
-        "flax",
-        "optax",
-        "orbax-checkpoint",
-        "numpy",
+        # floors match the APIs the code depends on: top-level
+        # jax.shard_map, lax.pcast, and the vma-aware shard_map transpose
+        # semantics the DP gradient math relies on (validated on 0.9.x)
+        "jax>=0.7",
+        "flax>=0.10",
+        "optax>=0.2",
+        "orbax-checkpoint>=0.5",
+        "numpy>=1.26",
     ],
     extras_require={"test": ["pytest"]},
 )

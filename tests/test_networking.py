@@ -105,3 +105,64 @@ def test_async_training_over_the_wire():
 def test_determine_host_address():
     addr = net.determine_host_address()
     socket.inet_aton(addr)  # parses as IPv4
+
+
+def test_ps_method_error_returns_error_reply_and_keeps_serving():
+    """ADVICE r1: an op that raises on the PS (e.g. pull_with_clock on a
+    non-DynSGD server) must produce an {"error": ...} reply, not a dropped
+    connection; the same connection keeps working afterwards."""
+    import pytest
+
+    center = {"w": np.zeros(4, dtype=np.float32)}
+    ps = DeltaParameterServer(center)
+    svc = net.ParameterServerService(ps, host="127.0.0.1")
+    svc.start()
+    try:
+        remote = net.RemoteParameterServer("127.0.0.1", svc.port)
+        with pytest.raises(RuntimeError, match="AttributeError"):
+            remote.pull_with_clock()  # DeltaParameterServer has no clock
+        # connection survived the error
+        np.testing.assert_array_equal(remote.pull()["w"], np.zeros(4))
+        remote.close()
+    finally:
+        svc.stop()
+
+
+def test_auth_handshake_required_when_secret_set():
+    center = {"w": np.zeros(2, dtype=np.float32)}
+    ps = DeltaParameterServer(center)
+    svc = net.ParameterServerService(ps, host="127.0.0.1", secret="s3kr1t")
+    svc.start()
+    try:
+        import pytest
+
+        bad = net.RemoteParameterServer("127.0.0.1", svc.port)
+        with pytest.raises((ConnectionError, RuntimeError)):
+            bad.pull()  # no secret -> rejected
+        good = net.RemoteParameterServer("127.0.0.1", svc.port, secret="s3kr1t")
+        np.testing.assert_array_equal(good.pull()["w"], np.zeros(2))
+        good.close()
+    finally:
+        svc.stop()
+
+
+def test_oversized_frame_rejected():
+    """The 8-byte length header must not be able to demand an unbounded
+    allocation (ADVICE r1)."""
+    import pytest
+    import socket as socket_mod
+    import struct
+
+    srv = socket_mod.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    cli = socket_mod.create_connection(srv.getsockname())
+    conn, _ = srv.accept()
+    try:
+        cli.sendall(struct.pack(">Q", 1 << 62))
+        with pytest.raises(ConnectionError, match="exceeds"):
+            net.recv_frame(conn, max_bytes=1 << 20)
+    finally:
+        cli.close()
+        conn.close()
+        srv.close()

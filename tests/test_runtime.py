@@ -1,0 +1,93 @@
+"""Multi-host runtime bootstrap (VERDICT r1 #4): Job -> DK_TPU_* env ->
+runtime.initialize -> DistributedTrainer auto-wiring a PS service on the
+coordinator and remote proxies elsewhere. Exercised as two REAL local
+processes training one DOWNPOUR center over loopback."""
+
+import os
+import socket
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from distkeras_tpu.job_deployment import Job
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+TRAIN_SCRIPT = textwrap.dedent("""
+    import os, sys
+    sys.path.insert(0, {repo!r})
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from distkeras_tpu import runtime
+    from distkeras_tpu.data.dataset import PartitionedDataset
+    from distkeras_tpu.models import get_model
+    from distkeras_tpu.trainers import DOWNPOUR
+
+    ctx = runtime.initialize()
+    assert ctx is not None, "runtime context missing"
+    assert jax.process_count() == ctx.num_processes  # jax.distributed is up
+
+    rng = np.random.default_rng(0)
+    n, d, c = 512, 8, 3
+    centers = rng.normal(size=(c, d)) * 3
+    lab = rng.integers(0, c, size=n)
+    X = (centers[lab] + rng.normal(size=(n, d))).astype(np.float32)
+    Y = np.eye(c, dtype=np.float32)[lab]
+    # each process trains on its own half (the reference's per-executor
+    # partition, with processes playing executors)
+    half = slice(0, n // 2) if ctx.process_id == 0 else slice(n // 2, n)
+    ds = PartitionedDataset.from_arrays(
+        {{"features": X[half], "label": Y[half]}}, num_partitions=2
+    )
+
+    t = DOWNPOUR(model=get_model("mlp", features=(16,), num_classes=3),
+                 num_workers=2, batch_size=32, num_epoch=2,
+                 communication_window=2, learning_rate=0.05,
+                 label_col="label")
+    m = t.train(ds)
+    flat = np.concatenate([np.asarray(x).ravel() for x in jax.tree.leaves(m.params)])
+    out = os.environ["DK_TEST_OUT"]
+    np.save(os.path.join(out, f"params_{{ctx.process_id}}.npy"), flat)
+    if ctx.process_id == 0:
+        with open(os.path.join(out, "updates.txt"), "w") as fh:
+            fh.write(str(t.parameter_server.num_updates))
+    runtime.shutdown()
+""")
+
+
+def test_job_two_process_loopback_training(tmp_path):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = tmp_path / "train2.py"
+    script.write_text(TRAIN_SCRIPT.format(repo=repo))
+
+    job = Job(
+        str(script),
+        hosts=["local", "local"],
+        coordinator_port=_free_port(),
+        ps_port=_free_port(),
+        env={
+            "DK_TEST_OUT": str(tmp_path),
+            "DK_TPU_SECRET": "test-secret",
+            "JAX_PLATFORMS": "cpu",
+        },
+        python=sys.executable,
+    )
+    job.run(wait=True)
+
+    p0 = np.load(tmp_path / "params_0.npy")
+    p1 = np.load(tmp_path / "params_1.npy")
+    # both processes observed the same final center
+    np.testing.assert_allclose(p0, p1, rtol=1e-6)
+    # commits arrived from both processes (4 workers x >=2 rounds)
+    assert int((tmp_path / "updates.txt").read_text()) >= 8
