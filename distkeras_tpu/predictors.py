@@ -31,14 +31,32 @@ class Predictor:
 
 class ModelPredictor(Predictor):
     """Append ``output_col`` = model(features) per row
-    (reference: predictors.py · ModelPredictor)."""
+    (reference: predictors.py · ModelPredictor).
+
+    Host<->device traffic engineering (the inference path is transfer-bound,
+    not FLOP-bound): chunk applies are dispatched asynchronously so uploads,
+    compute, and downloads pipeline instead of serializing per chunk, and
+    when the model computes in a narrower dtype (e.g. bfloat16) the cast
+    happens host-side before upload — numerically identical to the model's
+    own on-device cast, at half the bytes over PCIe/DCN.
+    """
 
     def __init__(self, model: Model, features_col: str = "features",
-                 output_col: str = "prediction", batch_size: int = 512):
+                 output_col: str = "prediction", batch_size: int = 512,
+                 transfer_dtype=None):
         self.model = model
         self.features_col = features_col
         self.output_col = output_col
         self.batch_size = batch_size
+        # default: the module's own compute dtype (it would cast on device
+        # anyway); None disables host-side casting
+        if transfer_dtype is None:
+            transfer_dtype = getattr(model.module, "dtype", None)
+        self.transfer_dtype = transfer_dtype
+
+    # chunks allowed in flight at once: enough to overlap upload, compute,
+    # and download, small enough that queued inputs never approach HBM
+    _MAX_IN_FLIGHT = 4
 
     def _predict_array(self, x: np.ndarray) -> np.ndarray:
         """Fixed-shape batched apply: every XLA call sees exactly
@@ -46,19 +64,35 @@ class ModelPredictor(Predictor):
         so ONE compiled program serves any partition size — including empty
         partitions, which still produce a correctly-shaped ``[0, ...]``
         output."""
+        from distkeras_tpu.utils.transfer import narrow_cast
+
         n = len(x)
         B = self.batch_size
+        x = narrow_cast(x, self.transfer_dtype)
         row_shape = x.shape[1:]
+        starts = list(range(0, max(n, 1), B))
+        pending: list = []  # (start, device_out), bounded in-flight window
         outs = []
-        for s in range(0, max(n, 1), B):
+
+        def drain_one():
+            s, dev_out = pending.pop(0)
+            out = np.asarray(dev_out)
+            outs.append(out[: min(B, n - s)] if n - s < B else out)
+
+        for s in starts:
             chunk = x[s : s + B]
             if len(chunk) < B:
                 pad = np.zeros((B - len(chunk),) + row_shape, dtype=x.dtype)
                 chunk = np.concatenate([chunk, pad], axis=0) if len(chunk) else pad
-            out = np.asarray(
-                self.model.apply_jit(self.model.params, jnp.asarray(chunk))
+            # async dispatch: chunk i+1's upload overlaps chunk i's
+            # compute/download, with bounded device residency
+            pending.append(
+                (s, self.model.apply_jit(self.model.params, jnp.asarray(chunk)))
             )
-            outs.append(out[: min(B, n - s)] if n - s < B else out)
+            if len(pending) >= self._MAX_IN_FLIGHT:
+                drain_one()
+        while pending:
+            drain_one()
         result = np.concatenate(outs, axis=0)
         return result[:n]
 

@@ -1,0 +1,23 @@
+"""Host->device transfer helpers.
+
+The feeding paths (worker staging, predictor chunks) are transfer-bound
+long before they are FLOP-bound; when the model's first op casts to a
+narrower compute dtype anyway, doing that cast on the HOST is bit-identical
+and halves the bytes over PCIe/DCN.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def narrow_cast(x: np.ndarray, target_dtype) -> np.ndarray:
+    """Cast ``x`` to ``target_dtype`` only when that narrows a floating
+    array (never widen, never touch ints/bools — labels and token ids pass
+    through untouched)."""
+    if target_dtype is None:
+        return x
+    td = np.dtype(target_dtype)
+    if np.issubdtype(x.dtype, np.floating) and td.itemsize < x.dtype.itemsize:
+        return x.astype(td)
+    return x

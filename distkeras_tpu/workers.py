@@ -204,7 +204,14 @@ class Worker:
 
     def _stage(self, xb: np.ndarray, yb: np.ndarray):
         """Upload the whole partition once if it fits the staging budget;
-        otherwise leave it on host (callers then stage per-window slices)."""
+        otherwise return it host-side (callers then stage slices per
+        window/epoch). Features are narrowed to the model's compute dtype
+        on the host first — the model's first op casts on device anyway
+        (same rounding, bit-identical results), so this just halves the
+        host->device bytes, the dominant cost of feeding workers."""
+        from distkeras_tpu.utils.transfer import narrow_cast
+
+        xb = narrow_cast(xb, getattr(self.module, "dtype", None))
         if xb.nbytes + yb.nbytes <= self.stage_limit_bytes:
             return self._put(xb), self._put(yb), True
         return xb, yb, False
@@ -251,8 +258,10 @@ class SequentialWorker(Worker):
         self.index = index
         xb, yb = self.batches(partition)
         # one host->device upload for the whole run when it fits HBM
-        # (else per-epoch upload, the pre-staging behavior)
+        # (else per-epoch upload of the host-cast arrays)
         xb_d, yb_d, staged = self._stage(xb, yb)
+        if not staged:
+            xb, yb = xb_d, yb_d  # host arrays, already narrow-cast
         params, opt_state = self.params, self.opt_state
         history: History = []
         callback = getattr(self, "epoch_callback", None)
