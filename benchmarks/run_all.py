@@ -112,12 +112,17 @@ def _async_throughput(trainer_cls, num_workers, epochs=3, **extra):
     x = rng.normal(size=(n, 32, 32, 3)).astype(np.float32)
     y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, size=n)]
     ds = _dataset(x, y)
-    trainer = trainer_cls(
-        model=get_model("cifar_cnn"), num_workers=num_workers,
-        batch_size=256, num_epoch=epochs, communication_window=16,
-        learning_rate=0.05, label_col="label", **extra,
-    )
-    # warm epoch compiles; measure with trainer timing over the full run
+    def make_trainer(num_epoch):
+        return trainer_cls(
+            model=get_model("cifar_cnn"), num_workers=num_workers,
+            batch_size=256, num_epoch=num_epoch, communication_window=16,
+            learning_rate=0.05, label_col="label", **extra,
+        )
+
+    # warm-up run: pays XLA compiles + first-touch staging so the timed run
+    # measures steady-state throughput, not compile-cache state
+    make_trainer(num_epoch=1).train(ds)
+    trainer = make_trainer(num_epoch=epochs)
     t0 = time.perf_counter()
     trainer.train(ds)
     dt = time.perf_counter() - t0

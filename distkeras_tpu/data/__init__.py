@@ -1,3 +1,8 @@
 """Partitioned, columnar datasets — the Spark-RDD/DataFrame stand-in."""
 
 from distkeras_tpu.data.dataset import PartitionedDataset  # noqa: F401
+from distkeras_tpu.data.spark_adapter import (  # noqa: F401
+    dataset_from_spark,
+    dataset_from_spark_session,
+    spark_available,
+)

@@ -100,8 +100,17 @@ def native_transport_active() -> bool:
 MAX_FRAME_BYTES = 1 << 33  # 8 GiB
 
 
+def _native_usable(sock: socket.socket):
+    """The C data plane does raw blocking send/recv on the fd; a Python-level
+    timeout puts the fd in non-blocking mode (EAGAIN mid-frame), so only use
+    the native path on fully blocking sockets."""
+    if sock.gettimeout() is not None:
+        return None
+    return _load_native()
+
+
 def send_frame(sock: socket.socket, payload: bytes):
-    lib = _load_native()
+    lib = _native_usable(sock)
     if lib:
         rc = lib.dk_send_frame(sock.fileno(), payload, len(payload))
         if rc != 0:
@@ -115,7 +124,7 @@ def recv_frame(
 ) -> Optional[bytes]:
     """One frame, or None on clean EOF. Frames over ``max_bytes`` raise
     (and the caller should drop the connection) instead of allocating."""
-    lib = _load_native()
+    lib = _native_usable(sock)
     if lib:
         size = lib.dk_recv_frame_size(sock.fileno())
         if size < 0:

@@ -43,16 +43,18 @@ class ModelPredictor(Predictor):
 
     def __init__(self, model: Model, features_col: str = "features",
                  output_col: str = "prediction", batch_size: int = 512,
-                 transfer_dtype=None):
+                 transfer_dtype="auto"):
+        from distkeras_tpu.utils.transfer import resolve_transfer_dtype
+
         self.model = model
         self.features_col = features_col
         self.output_col = output_col
         self.batch_size = batch_size
-        # default: the module's own compute dtype (it would cast on device
-        # anyway); None disables host-side casting
-        if transfer_dtype is None:
-            transfer_dtype = getattr(model.module, "dtype", None)
-        self.transfer_dtype = transfer_dtype
+        # "auto" → the module's own compute dtype (it would cast on device
+        # anyway); None → explicitly no host-side cast
+        self.transfer_dtype = resolve_transfer_dtype(
+            model.module, transfer_dtype
+        )
 
     # chunks allowed in flight at once: enough to overlap upload, compute,
     # and download, small enough that queued inputs never approach HBM

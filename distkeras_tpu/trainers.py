@@ -392,10 +392,19 @@ class DistributedTrainer(Trainer):
     def __init__(self, *args, num_workers: int = 2,
                  communication_window: int = 5,
                  remote_ps: Optional[tuple] = None,
-                 devices: Optional[Sequence] = None, **kwargs):
+                 devices: Optional[Sequence] = None,
+                 max_retries: int = 0, **kwargs):
         super().__init__(*args, **kwargs)
         self.num_workers = num_workers
         self.communication_window = communication_window
+        # Failure recovery (SURVEY.md §5.3 — the reference had NONE: a dead
+        # executor either deadlocked the run or was silently re-run by Spark,
+        # double-counting its updates). Here a crashed worker is restarted
+        # up to max_retries times from the CURRENT center (its first act is
+        # a fresh pull), so no update is ever double-counted and the center
+        # never loses committed progress.
+        self.max_retries = max_retries
+        self.worker_restarts = 0
         # (host, port) of a ParameterServerService on another host: this
         # process then contributes workers over DCN instead of owning the
         # center (multi-host async topology; see networking.py)
@@ -430,6 +439,7 @@ class DistributedTrainer(Trainer):
     def _train(self, dataset: PartitionedDataset, shuffle: bool = False) -> Model:
         from distkeras_tpu import runtime
 
+        self.worker_restarts = 0  # per-run counter (trainers are reusable)
         if shuffle:
             dataset = dataset.shuffle(seed=self.seed)
         n_parts = self.num_workers * self.parallelism_factor
@@ -543,13 +553,43 @@ class DistributedTrainer(Trainer):
 
             ps.extra_state_fn = _worker_states
 
+        restart_lock = threading.Lock()
+
         def run(i: int):
             gi = worker_offset + i  # globally-unique worker id
+            attempts = 0
             try:
-                _, history = workers[i].train(gi, dataset.partition(i), ps)
-                results[i] = history
-            except BaseException as e:  # surface worker failures to driver
-                errors.append(e)
+                while True:
+                    try:
+                        _, history = workers[i].train(
+                            gi, dataset.partition(i), ps
+                        )
+                        results[i] = history
+                        return
+                    except (KeyboardInterrupt, SystemExit):
+                        raise
+                    except BaseException as e:
+                        if attempts >= self.max_retries:
+                            # out of budget: surface to the driver
+                            errors.append(e)
+                            return
+                        attempts += 1
+                        # Restart: fresh worker object (clean opt_state),
+                        # same device slot and global id, sharing the
+                        # already-compiled step. Its on_start pulls the
+                        # current center, so committed progress survives
+                        # and nothing is replayed twice. A sync (EASGD)
+                        # restart re-enters the barrier under the same id;
+                        # finished peers leave and shrink it, so the
+                        # restarted worker's extra rounds cannot deadlock.
+                        with restart_lock:
+                            self.worker_restarts += 1
+                        replacement = self.allocate_worker(i)
+                        replacement.metrics_writer = self.metrics_writer
+                        old = workers[i]
+                        if getattr(old, "step", None) is not None:
+                            replacement.set_compiled(old.step, old.window_step)
+                        workers[i] = replacement
             finally:
                 # shrink any synchronous barrier so survivors never deadlock
                 ps.leave(gi)
@@ -612,6 +652,10 @@ class DistributedTrainer(Trainer):
             self.metrics_writer.summary(
                 "staleness", histogram=self.staleness,
                 num_updates=ps.num_updates,
+            )
+        if self.metrics_writer is not None and self.worker_restarts:
+            self.metrics_writer.summary(
+                "failures", worker_restarts=self.worker_restarts
             )
         if errors:
             raise errors[0]

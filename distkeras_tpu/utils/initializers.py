@@ -1,0 +1,38 @@
+"""Weight-initialization helpers.
+
+Reference: distkeras/utils.py · uniform_weights [UNCERTAIN in fork] —
+reinitializes a Keras model's weight matrices from a uniform distribution,
+used to give ensemble members distinct starting points. The TPU-native
+equivalent is a pure pytree→pytree function (no model mutation)."""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def uniform_weights(
+    params: Any,
+    bounds: Tuple[float, float] = (-0.5, 0.5),
+    seed: int = 0,
+) -> Any:
+    """Fresh params with every leaf ~ U[bounds), same shapes/dtypes.
+
+    Pure: returns a new pytree; per-leaf keys are split from ``seed`` so
+    two different seeds give fully independent draws.
+    """
+    lo, hi = bounds
+    if not hi > lo:
+        raise ValueError(f"bounds must satisfy low < high, got {bounds}")
+    leaves, treedef = jax.tree.flatten(params)
+    keys = jax.random.split(jax.random.PRNGKey(seed), len(leaves))
+    new_leaves = [
+        jax.random.uniform(
+            k, shape=jnp.shape(leaf), dtype=jnp.asarray(leaf).dtype,
+            minval=lo, maxval=hi,
+        )
+        for k, leaf in zip(keys, leaves)
+    ]
+    return jax.tree.unflatten(treedef, new_leaves)

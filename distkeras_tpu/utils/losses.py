@@ -103,9 +103,23 @@ def get_optimizer(name, learning_rate: float = 0.01, **kwargs) -> optax.Gradient
     Reference: distkeras/trainers.py · Trainer takes ``worker_optimizer`` as
     a Keras optimizer string ('adagrad', 'adam', 'sgd', …) compiled into each
     worker's local model. Accepts an ``optax.GradientTransformation`` as-is.
+
+    Same (name, lr, kwargs) → the SAME GradientTransformation object
+    (optax transforms are pure init/update pairs, safe to share). Stable
+    identity is what lets the jitted-step memo in
+    :func:`distkeras_tpu.workers.share_compiled` hit across trainer runs —
+    a second trainer over the same config reuses the compiled XLA program
+    instead of re-tracing.
     """
     if isinstance(name, optax.GradientTransformation):
         return name
+    try:
+        key = (name, float(learning_rate), tuple(sorted(kwargs.items())))
+        cached = _OPTIMIZER_CACHE.get(key)
+        if cached is not None:
+            return cached
+    except TypeError:  # unhashable kwarg (e.g. a schedule object): no memo
+        key = None
     table = {
         "sgd": optax.sgd,
         "momentum": lambda lr, **kw: optax.sgd(lr, momentum=kw.pop("momentum", 0.9), **kw),
@@ -122,4 +136,10 @@ def get_optimizer(name, learning_rate: float = 0.01, **kwargs) -> optax.Gradient
         factory = table[name]
     except KeyError:
         raise ValueError(f"Unknown optimizer '{name}'. Known: {sorted(table)}") from None
-    return factory(learning_rate, **kwargs)
+    opt = factory(learning_rate, **kwargs)
+    if key is not None:
+        _OPTIMIZER_CACHE[key] = opt
+    return opt
+
+
+_OPTIMIZER_CACHE: dict = {}

@@ -11,6 +11,29 @@ from __future__ import annotations
 import numpy as np
 
 
+def resolve_transfer_dtype(module, transfer_dtype):
+    """Resolve the host-side cast dtype for a feeding path.
+
+    ``"auto"`` (the default everywhere) → the module's own compute dtype
+    (it would cast on device anyway; casting on host is bit-identical at
+    half the bytes). ``None`` → explicitly NO host-side cast (upload
+    full-precision). Anything else is used as-is.
+    """
+    if transfer_dtype == "auto":
+        return getattr(module, "dtype", None)
+    return transfer_dtype
+
+
+def pad_to_rows(x: np.ndarray, rows: int) -> np.ndarray:
+    """Pad the leading axis up to ``rows`` by repeating the first row, so
+    every XLA call sees one fixed shape (zero recompiles); callers slice
+    the pad off after the apply."""
+    if len(x) >= rows:
+        return x
+    pad = np.repeat(x[:1], rows - len(x), axis=0)
+    return np.concatenate([x, pad], axis=0)
+
+
 def narrow_cast(x: np.ndarray, target_dtype) -> np.ndarray:
     """Cast ``x`` to ``target_dtype`` only when that narrows a floating
     array (never widen, never touch ints/bools — labels and token ids pass

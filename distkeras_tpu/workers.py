@@ -125,13 +125,37 @@ def batch_partition(
     return xb, yb
 
 
+_STEP_MEMO: dict = {}
+
+
+def _shared_steps(module, loss_fn, optimizer, metrics):
+    """One (step, window_step) pair per training config, memoized across
+    trainer runs. flax modules hash by (type, config) and the registries
+    (losses, metrics, get_optimizer) return identity-stable objects, so a
+    second trainer over the same config reuses the same jitted callables —
+    and therefore jax's compile cache — instead of re-tracing/re-compiling
+    (benchmark warm-up runs actually warm; repeated train() calls on real
+    chips skip the 20-40s first-compile)."""
+    try:
+        key = (module, loss_fn, id(optimizer), tuple(metrics))
+        hash(key)
+    except TypeError:
+        key = None
+    if key is not None and key in _STEP_MEMO:
+        return _STEP_MEMO[key]
+    step = make_train_step(module.apply, loss_fn, optimizer, metrics)
+    window = make_window_step(module.apply, loss_fn, optimizer, metrics)
+    if key is not None:
+        _STEP_MEMO[key] = (step, window)
+    return step, window
+
+
 def share_compiled(workers: List["Worker"]):
     """Give every worker one shared optimizer + one pair of jitted steps
     (their configs are identical), avoiding num_workers x redundant XLA
     compiles of the same program."""
     w0 = workers[0]
-    step = make_train_step(w0.module.apply, w0.loss_fn, w0.optimizer, w0.metrics)
-    window = make_window_step(w0.module.apply, w0.loss_fn, w0.optimizer, w0.metrics)
+    step, window = _shared_steps(w0.module, w0.loss_fn, w0.optimizer, w0.metrics)
     for w in workers:
         w.optimizer = w0.optimizer
         w.set_compiled(step, window)

@@ -1,0 +1,75 @@
+"""Streaming inference example — the reference's Kafka demo, TPU-native.
+
+Reference: examples/kafka (SURVEY.md §2 [UNCERTAIN]) — Spark Streaming
+micro-batches records from a Kafka topic and a Keras model scores each
+batch. Here a :class:`RecordProducer` serves records over TCP (the broker
+stand-in in the zero-egress image; swap in ``kafka_source`` when a real
+broker exists), and :class:`StreamingPredictor` consumes them in padded
+fixed-shape micro-batches — one compiled XLA apply for the whole stream.
+
+Run: python examples/streaming_inference.py [--n 4096] [--batch-size 256]
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+from distkeras_tpu.models import get_model
+from distkeras_tpu.models.wrapper import Model
+from distkeras_tpu.streaming import (
+    RecordProducer,
+    StreamingPredictor,
+    socket_source,
+)
+import jax
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=4096, help="records to stream")
+    ap.add_argument("--batch-size", type=int, default=256)
+    ap.add_argument("--dim", type=int, default=784)
+    args = ap.parse_args()
+
+    rng = np.random.default_rng(0)
+    records = [
+        {"id": i, "features": rng.normal(size=args.dim).astype(np.float32)}
+        for i in range(args.n)
+    ]
+
+    module = get_model("mlp", features=(256, 128), num_classes=10)
+    params = module.init(
+        jax.random.PRNGKey(0), np.zeros((1, args.dim), np.float32)
+    )
+    model = Model(module, params)
+
+    producer = RecordProducer(records, chunk=64).start()
+    predictor = StreamingPredictor(
+        model, batch_size=args.batch_size, max_latency_s=0.1
+    )
+
+    t0 = time.time()
+    n_out, checksum = 0, 0.0
+    for rec in predictor.predict_stream(
+        socket_source(producer.host, producer.port, timeout=30)
+    ):
+        n_out += 1
+        checksum += float(rec["prediction"].sum())
+    dt = time.time() - t0
+    producer.join()
+
+    assert n_out == args.n, f"stream dropped records: {n_out}/{args.n}"
+    print(
+        f"streamed {n_out} records in {dt:.2f}s "
+        f"({n_out / dt:.0f} rec/s, {predictor.batches_run} micro-batches, "
+        f"checksum {checksum:.3f})"
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,201 @@
+"""Property tests on the async parameter-server path (SURVEY.md §5.2).
+
+The reference's only concurrency defense was one ``threading.Lock`` around
+center mutation, never tested. These tests hammer the PS objects from many
+threads and check the algebraic invariants that must hold REGARDLESS of
+interleaving:
+
+- no lost updates: the center is exactly init + (sum of all commits' math),
+  checked with integer-valued floats so addition order cannot blur the
+  answer;
+- no torn reads: every concurrent ``pull`` sees a center from a single
+  commit (all leaves consistent);
+- clock sanity: DynSGD's global clock counts every commit, staleness is
+  non-negative and bounded;
+- barrier liveness: EASGD rounds complete under randomized leave schedules.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from distkeras_tpu.parameter_servers import (
+    ADAGParameterServer,
+    DeltaParameterServer,
+    DynSGDParameterServer,
+    EASGDParameterServer,
+)
+
+
+def int_tree(value, shape=(4, 3)):
+    """Integer-valued float64 tree: float addition of small integers is
+    exact in any order, so the no-lost-update check is bit-exact."""
+    return {
+        "w": np.full(shape, float(value)),
+        "b": np.full((5,), float(value)),
+    }
+
+
+def run_threads(fns, timeout=60):
+    threads = [threading.Thread(target=f, daemon=True) for f in fns]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout)
+        assert not t.is_alive(), "worker thread hung (deadlock)"
+
+
+N_WORKERS = 8
+COMMITS_EACH = 50
+
+
+def test_delta_ps_no_lost_updates():
+    ps = DeltaParameterServer(int_tree(0))
+    rng = np.random.default_rng(0)
+    # per-worker integer deltas, fixed up-front so the expected sum is known
+    deltas = rng.integers(-3, 4, size=(N_WORKERS, COMMITS_EACH))
+    start = threading.Barrier(N_WORKERS)
+
+    def worker(i):
+        start.wait()
+        for d in deltas[i]:
+            ps.commit(int_tree(int(d)), worker=i)
+
+    run_threads([lambda i=i: worker(i) for i in range(N_WORKERS)])
+    expected = float(deltas.sum())
+    final = ps.get_model()
+    np.testing.assert_array_equal(final["w"], np.full((4, 3), expected))
+    np.testing.assert_array_equal(final["b"], np.full((5,), expected))
+    assert ps.num_updates == N_WORKERS * COMMITS_EACH
+
+
+def test_adag_ps_normalized_accumulation_exact():
+    # num_workers = 4 (a power of two): delta/4 is exact in binary floats
+    k = 4
+    ps = ADAGParameterServer(int_tree(0), num_workers=k)
+    rng = np.random.default_rng(1)
+    deltas = rng.integers(-8, 9, size=(k, COMMITS_EACH))
+    start = threading.Barrier(k)
+
+    def worker(i):
+        start.wait()
+        for d in deltas[i]:
+            ps.commit(int_tree(int(d)), worker=i)
+
+    run_threads([lambda i=i: worker(i) for i in range(k)])
+    expected = float(deltas.sum()) / k
+    np.testing.assert_array_equal(
+        ps.get_model()["w"], np.full((4, 3), expected)
+    )
+    assert ps.num_updates == k * COMMITS_EACH
+
+
+def test_pull_never_tears():
+    """Every concurrent pull must return a snapshot where all leaves agree
+    (all from the same commit) — a torn read would mix generations."""
+    ps = DeltaParameterServer(int_tree(0))
+    stop = threading.Event()
+    torn = []
+
+    def committer():
+        for _ in range(300):
+            ps.commit(int_tree(1))
+        stop.set()
+
+    def puller():
+        while not stop.is_set():
+            snap = ps.pull()
+            vals = {float(v) for leaf in snap.values() for v in leaf.ravel()}
+            if len(vals) != 1:
+                torn.append(vals)
+
+    run_threads([committer] + [puller] * 4)
+    assert not torn, f"torn reads observed: {torn[:3]}"
+    np.testing.assert_array_equal(ps.get_model()["w"], np.full((4, 3), 300.0))
+
+
+def test_dynsgd_clock_and_staleness_invariants():
+    ps = DynSGDParameterServer(int_tree(0))
+    total = N_WORKERS * COMMITS_EACH
+    start = threading.Barrier(N_WORKERS)
+
+    def worker(i):
+        start.wait()
+        for _ in range(COMMITS_EACH):
+            _, clock = ps.pull_with_clock()
+            ps.commit(int_tree(1), worker=i, worker_clock=clock)
+
+    run_threads([lambda i=i: worker(i) for i in range(N_WORKERS)])
+    assert ps.clock == total  # every commit advanced the global clock once
+    assert ps.num_updates == total
+    log = ps.staleness_log
+    assert len(log) == total
+    assert all(0 <= s < total for s in log)
+    # with 8 racing workers, SOME staleness must have been observed, and a
+    # worker can be at most (N_WORKERS - 1) commits behind per round-trip
+    # window times its own window count — sanity-bound it loosely
+    assert max(log) >= 1
+
+
+def test_dynsgd_staleness_scaling_math_serial():
+    """Serial ground truth: with known clocks the center is exactly
+    init + sum(delta / (staleness + 1))."""
+    ps = DynSGDParameterServer(int_tree(0))
+    # commit with worker_clock pinned to 0 as the clock advances: staleness
+    # = current clock, scale = 1/(clock+1)
+    for _ in range(4):
+        ps.commit(int_tree(1), worker_clock=0)
+    expected = 1.0 + 1.0 / 2 + 1.0 / 3 + 1.0 / 4
+    # the rule math runs in jnp float32 (x64 off), so tolerance is f32 eps
+    np.testing.assert_allclose(
+        ps.get_model()["w"], np.full((4, 3), expected), rtol=1e-6
+    )
+    assert ps.staleness_log == [0, 1, 2, 3]
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_easgd_barrier_liveness_random_leaves(seed):
+    """Workers do different numbers of rounds (random), leaving as they
+    finish; the barrier must shrink and every thread must terminate."""
+    k = 6
+    rng = np.random.default_rng(seed)
+    rounds = rng.integers(1, 8, size=k)
+    ps = EASGDParameterServer(int_tree(0), num_workers=k, rho=1.0,
+                              elastic_lr=0.1)
+
+    def worker(i):
+        for r in range(int(rounds[i])):
+            ps.commit_and_wait(int_tree(i + r), worker=i)
+        ps.leave(i)
+
+    run_threads([lambda i=i: worker(i) for i in range(k)])
+    assert ps.num_updates >= int(rounds.min())
+
+
+def test_easgd_round_returns_consistent_pre_round_center():
+    """All workers in one round observe the SAME pre-round center."""
+    k = 4
+    ps = EASGDParameterServer(int_tree(0), num_workers=k, rho=1.0,
+                              elastic_lr=0.25)
+    rounds = 5
+    seen = [[] for _ in range(k)]
+
+    def worker(i):
+        for r in range(rounds):
+            center = ps.commit_and_wait(int_tree(1), worker=i)
+            seen[i].append(float(center["w"][0, 0]))
+        ps.leave(i)
+
+    run_threads([lambda i=i: worker(i) for i in range(k)])
+    for r in range(rounds):
+        vals = {seen[i][r] for i in range(k)}
+        assert len(vals) == 1, f"round {r} returned mixed centers: {vals}"
+    # alpha = 0.25 * 1.0; per round center += alpha * sum_i(w_i - center)
+    # with all w_i = 1: center_{t+1} = center_t + k*alpha*(1 - center_t)
+    c = 0.0
+    expected_seen = []
+    for _ in range(rounds):
+        expected_seen.append(c)
+        c = c + k * 0.25 * (1.0 - c)
+    np.testing.assert_allclose(seen[0], expected_seen, rtol=1e-5, atol=1e-7)

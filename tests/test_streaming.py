@@ -1,0 +1,140 @@
+"""Streaming inference tests (reference parity: the Kafka micro-batch
+example, SURVEY.md §2 · Examples) — plus precache/uniform_weights utils."""
+
+import numpy as np
+import jax
+import pytest
+
+from distkeras_tpu.data.dataset import PartitionedDataset
+from distkeras_tpu.models import get_model
+from distkeras_tpu.models.wrapper import Model
+from distkeras_tpu.streaming import (
+    RecordProducer,
+    StreamingPredictor,
+    iterator_source,
+    kafka_source,
+    socket_source,
+)
+from distkeras_tpu.utils import uniform_weights
+
+
+def make_model(dim=8, classes=4, seed=0):
+    module = get_model("mlp", features=(16,), num_classes=classes)
+    params = module.init(
+        jax.random.PRNGKey(seed), np.zeros((1, dim), np.float32)
+    )
+    return Model(module, params)
+
+
+def make_records(n, dim=8, seed=0):
+    rng = np.random.default_rng(seed)
+    return [
+        {"id": i, "features": rng.normal(size=dim).astype(np.float32)}
+        for i in range(n)
+    ]
+
+
+def test_stream_matches_batch_predict():
+    model = make_model()
+    records = make_records(50)
+    pred = StreamingPredictor(model, batch_size=16, max_latency_s=None)
+    out = list(pred.predict_stream(iterator_source(records)))
+    assert [r["id"] for r in out] == list(range(50))
+    x = np.stack([r["features"] for r in records])
+    np.testing.assert_allclose(
+        np.stack([r["prediction"] for r in out]),
+        model.predict(x),
+        rtol=1e-5, atol=1e-6,
+    )
+    # 50 records / batch 16 → 3 full + 1 padded partial micro-batch
+    assert pred.batches_run == 4
+    assert pred.records_seen == 50
+
+
+def test_stream_single_compile_fixed_shapes():
+    """Padding keeps every micro-batch the same shape: ragged tail included,
+    only one traced shape should exist."""
+    model = make_model()
+    pred = StreamingPredictor(model, batch_size=8, max_latency_s=None)
+    traced_shapes = set()
+    orig = pred._apply
+
+    def spy(params, x):
+        traced_shapes.add(tuple(x.shape))
+        return orig(params, x)
+
+    pred._apply = spy
+    list(pred.predict_stream(iterator_source(make_records(21))))
+    assert traced_shapes == {(8, 8)}
+
+
+def test_socket_source_end_to_end():
+    model = make_model()
+    records = make_records(40)
+    producer = RecordProducer(records, chunk=7).start()
+    pred = StreamingPredictor(model, batch_size=16, max_latency_s=0.05)
+    out = list(
+        pred.predict_stream(
+            socket_source(producer.host, producer.port, timeout=20)
+        )
+    )
+    producer.join()
+    assert [r["id"] for r in out] == list(range(40))
+    x = np.stack([r["features"] for r in records])
+    np.testing.assert_allclose(
+        np.stack([r["prediction"] for r in out]),
+        model.predict(x),
+        rtol=1e-5, atol=1e-6,
+    )
+
+
+def test_kafka_source_gated():
+    with pytest.raises(ImportError, match="kafka-python"):
+        next(kafka_source("topic", bytes.decode))
+
+
+def test_precache_contiguous_and_equal():
+    rng = np.random.default_rng(0)
+    base = rng.normal(size=(32, 4, 4)).astype(np.float32)
+    # strided view: non-contiguous column
+    ds = PartitionedDataset.from_partitions(
+        [{"features": base[::2].transpose(0, 2, 1), "label": np.arange(16)}]
+    )
+    assert not ds.partition(0)["features"].flags["C_CONTIGUOUS"]
+    cached = ds.precache()
+    assert cached.partition(0)["features"].flags["C_CONTIGUOUS"]
+    np.testing.assert_array_equal(
+        cached.column("features"), ds.column("features")
+    )
+
+
+def test_uniform_weights_shapes_bounds_and_seeds():
+    model = make_model()
+    fresh = uniform_weights(model.params, bounds=(-0.25, 0.25), seed=1)
+    assert jax.tree.structure(fresh) == jax.tree.structure(model.params)
+    for a, b in zip(jax.tree.leaves(fresh), jax.tree.leaves(model.params)):
+        assert a.shape == np.shape(b)
+        assert float(np.max(np.abs(np.asarray(a)))) <= 0.25
+    again = uniform_weights(model.params, bounds=(-0.25, 0.25), seed=1)
+    other = uniform_weights(model.params, bounds=(-0.25, 0.25), seed=2)
+    for x, y in zip(jax.tree.leaves(again), jax.tree.leaves(fresh)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+    assert any(
+        not np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(jax.tree.leaves(other), jax.tree.leaves(fresh))
+    )
+    with pytest.raises(ValueError, match="low < high"):
+        uniform_weights(model.params, bounds=(1.0, -1.0))
+
+
+def test_streaming_example_smoke():
+    import subprocess
+    import sys
+
+    proc = subprocess.run(
+        [sys.executable, "examples/streaming_inference.py",
+         "--n", "128", "--batch-size", "32", "--dim", "16"],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "streamed 128 records" in proc.stdout
