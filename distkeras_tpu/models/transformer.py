@@ -3,19 +3,30 @@
 The reference has no attention models (SURVEY.md §5.7) — its workloads are
 MLP/CNN-scale. This module is the framework's capability extension for
 long-context, multi-chip training: a pre-norm decoder-only transformer whose
-attention implementation is pluggable so the same module runs
+parallelism is pluggable along two orthogonal mesh axes:
 
-- single-chip with standard fused causal attention, or
-- sequence-parallel with ring attention over a mesh axis
-  (:mod:`distkeras_tpu.ops.ring_attention`), activated by constructing with
-  ``attention='ring'`` inside a ``shard_map`` over the sequence axis.
+- **sequence parallel (sp)**: ``attention='ring'`` streams KV blocks around
+  the mesh axis (:mod:`distkeras_tpu.ops.ring_attention`), each device
+  holding T/sp of the sequence;
+- **tensor parallel (tp)**: ``tp_size>1`` shards attention heads and MLP
+  hidden features Megatron-style — column-parallel into the block, one
+  ``psum`` coming out (:class:`TPDenseGeneral`). Inside ``shard_map``,
+  JAX 0.9's vma-aware autodiff inserts the conjugate all-reduces in the
+  backward pass automatically (the "f/g" pair of Megatron-LM), so the
+  module stays a plain forward function.
+
+The same module value runs single-chip (``tp_size=1``, standard attention)
+or sharded; parameter trees are structurally identical, so a full-size init
+can be sliced onto the mesh by :func:`distkeras_tpu.parallel.spmd.lm_param_specs`.
 
 Design notes for the MXU/HBM: bfloat16 activations, d_model/heads sized in
-multiples of 128, single einsum per projection, no data-dependent control
+multiples of 128, single matmul per projection, no data-dependent control
 flow (jit-stable static shapes).
 """
 
 from __future__ import annotations
+
+from typing import Optional, Tuple
 
 import flax.linen as nn
 import jax
@@ -35,6 +46,70 @@ def sinusoidal_positions(max_len: int, dim: int) -> np.ndarray:
     return out
 
 
+class TPDenseGeneral(nn.Module):
+    """Dense projection with optional Megatron-style tensor sharding.
+
+    ``features`` is always the GLOBAL output feature shape; with
+    ``tp_size>1`` a ``'col'`` layer creates the local 1/tp_size slice of
+    its sharded feature dim, and a ``'row'`` layer consumes locally-sharded
+    inputs and ``psum``s its partial product over ``tp_axis`` before adding
+    the (replicated) bias — so col→(elementwise)→row needs exactly one
+    collective per pair. Parameter names/structure match the ``tp_size=1``
+    module, which is how a full-size host init slices onto the mesh.
+
+    Contraction is over the trailing ``in_axes`` axes of ``x`` (the only
+    form the transformer needs; keeps the kernel one reshaped matmul for
+    the MXU).
+    """
+
+    features: Tuple[int, ...]
+    in_axes: int = 1
+    mode: Optional[str] = None  # 'col' | 'row' | None
+    shard_dim: int = 0  # which features dim is sharded in 'col' mode
+    tp_size: int = 1
+    tp_axis: str = "tp"
+    dtype: jnp.dtype = jnp.bfloat16
+    use_bias: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        feats = list(self.features)
+        if self.mode == "col" and self.tp_size > 1:
+            if feats[self.shard_dim] % self.tp_size != 0:
+                raise ValueError(
+                    f"col-parallel feature dim {feats[self.shard_dim]} not "
+                    f"divisible by tp_size={self.tp_size}"
+                )
+            feats[self.shard_dim] //= self.tp_size
+        in_shape = tuple(x.shape[-self.in_axes:])
+        kernel = self.param(
+            "kernel",
+            nn.initializers.variance_scaling(
+                1.0, "fan_in", "truncated_normal",
+                in_axis=tuple(range(self.in_axes)),
+                out_axis=tuple(range(self.in_axes, self.in_axes + len(feats))),
+            ),
+            in_shape + tuple(feats),
+            jnp.float32,
+        )
+        fan_in = int(np.prod(in_shape))
+        xm = x.reshape(x.shape[: -self.in_axes] + (fan_in,)).astype(self.dtype)
+        km = kernel.reshape((fan_in, -1)).astype(self.dtype)
+        y = (xm @ km).reshape(x.shape[: -self.in_axes] + tuple(feats))
+        if self.mode == "row" and self.tp_size > 1:
+            # the Megatron g-op: one all-reduce completes the row-parallel
+            # product; its autodiff transpose broadcasts, and the col
+            # layer's broadcast transposes back to a psum — both inserted
+            # by shard_map's vma machinery.
+            y = jax.lax.psum(y, self.tp_axis)
+        if self.use_bias:
+            bias = self.param(
+                "bias", nn.initializers.zeros, tuple(feats), jnp.float32
+            )
+            y = y + bias.astype(self.dtype)
+        return y
+
+
 class CausalSelfAttention(nn.Module):
     num_heads: int
     dtype: jnp.dtype = jnp.bfloat16
@@ -42,6 +117,8 @@ class CausalSelfAttention(nn.Module):
     # 'dense', or 'ring' (sequence-parallel over seq_axis)
     attention: str = "standard"
     seq_axis: str = "sp"  # mesh axis name used when attention == 'ring'
+    tp_size: int = 1
+    tp_axis: str = "tp"
 
     _DENSE_MAX_T = 512  # short sequences: one fused dense block is fastest
 
@@ -50,8 +127,16 @@ class CausalSelfAttention(nn.Module):
         B, T, D = x.shape
         H = self.num_heads
         hd = D // H
-        qkv = nn.DenseGeneral((3, H, hd), dtype=self.dtype, name="qkv")(x)
-        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # [B, T, H, hd]
+        if H % self.tp_size != 0:
+            raise ValueError(
+                f"num_heads={H} not divisible by tp_size={self.tp_size}"
+            )
+        qkv = TPDenseGeneral(
+            features=(3, H, hd), in_axes=1, mode="col", shard_dim=1,
+            tp_size=self.tp_size, tp_axis=self.tp_axis, dtype=self.dtype,
+            name="qkv",
+        )(x)  # [B, T, 3, H_local, hd]
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         mode = self.attention
         if mode == "standard":
             mode = "dense" if T <= self._DENSE_MAX_T else "blocked"
@@ -76,7 +161,11 @@ class CausalSelfAttention(nn.Module):
                 f"Unknown attention mode '{self.attention}'. "
                 "Known: standard, dense, blocked, ring"
             )
-        return nn.DenseGeneral(D, axis=(-2, -1), dtype=self.dtype, name="out")(out)
+        return TPDenseGeneral(
+            features=(D,), in_axes=2, mode="row",
+            tp_size=self.tp_size, tp_axis=self.tp_axis, dtype=self.dtype,
+            name="out",
+        )(out)
 
 
 class Block(nn.Module):
@@ -85,24 +174,58 @@ class Block(nn.Module):
     dtype: jnp.dtype = jnp.bfloat16
     attention: str = "standard"
     seq_axis: str = "sp"
+    tp_size: int = 1
+    tp_axis: str = "tp"
+    # expert parallelism: >0 replaces the dense MLP with a SwitchMoE of
+    # this many (global) experts, sharded over ep_axis when ep_size > 1
+    moe_experts: int = 0
+    ep_size: int = 1
+    ep_axis: str = "ep"
 
     @nn.compact
     def __call__(self, x):
         D = x.shape[-1]
         h = nn.LayerNorm(dtype=self.dtype)(x)
         x = x + CausalSelfAttention(
-            self.num_heads, self.dtype, self.attention, self.seq_axis
+            self.num_heads, self.dtype, self.attention, self.seq_axis,
+            self.tp_size, self.tp_axis,
         )(h)
         h = nn.LayerNorm(dtype=self.dtype)(x)
-        h = nn.Dense(D * self.mlp_ratio, dtype=self.dtype)(h)
-        h = nn.gelu(h)
-        h = nn.Dense(D, dtype=self.dtype)(h)
+        if self.moe_experts > 0:
+            from distkeras_tpu.ops.moe import SwitchMoE
+
+            h = SwitchMoE(
+                num_experts=self.moe_experts,
+                hidden=D * self.mlp_ratio,
+                ep_size=self.ep_size,
+                ep_axis=self.ep_axis,
+                dtype=self.dtype,
+                name="moe",
+            )(h)
+        else:
+            h = TPDenseGeneral(
+                features=(D * self.mlp_ratio,), in_axes=1, mode="col",
+                tp_size=self.tp_size, tp_axis=self.tp_axis, dtype=self.dtype,
+                name="mlp_up",
+            )(h)
+            h = nn.gelu(h)
+            h = TPDenseGeneral(
+                features=(D,), in_axes=1, mode="row",
+                tp_size=self.tp_size, tp_axis=self.tp_axis, dtype=self.dtype,
+                name="mlp_down",
+            )(h)
         return x + h
 
 
 @register_model("transformer_lm")
 class TransformerLM(nn.Module):
-    """Decoder-only LM: tokens [B, T] int32 → logits [B, T, vocab] f32."""
+    """Decoder-only LM: tokens [B, T] int32 → logits [B, T, vocab] f32.
+
+    ``tp_size``/``tp_axis`` shard heads + MLP hidden tensor-parallel (only
+    meaningful inside a ``shard_map`` over ``tp_axis``); ``attention='ring'``
+    shards the sequence over ``seq_axis``. Both compose — see
+    :func:`distkeras_tpu.parallel.spmd.make_lm_train_step`.
+    """
 
     vocab_size: int = 1024
     d_model: int = 256
@@ -112,6 +235,11 @@ class TransformerLM(nn.Module):
     dtype: jnp.dtype = jnp.bfloat16
     attention: str = "standard"
     seq_axis: str = "sp"
+    tp_size: int = 1
+    tp_axis: str = "tp"
+    moe_experts: int = 0
+    ep_size: int = 1
+    ep_axis: str = "ep"
 
     @nn.compact
     def __call__(self, tokens, train: bool = False):
@@ -130,6 +258,24 @@ class TransformerLM(nn.Module):
                 dtype=self.dtype,
                 attention=self.attention,
                 seq_axis=self.seq_axis,
+                tp_size=self.tp_size,
+                tp_axis=self.tp_axis,
+                moe_experts=self.moe_experts,
+                ep_size=self.ep_size,
+                ep_axis=self.ep_axis,
             )(x)
         x = nn.LayerNorm(dtype=self.dtype)(x)
         return nn.Dense(self.vocab_size, dtype=jnp.float32)(x)
+
+
+@register_model("moe_lm")
+class MoeLM(TransformerLM):
+    """TransformerLM with Switch-MoE MLPs (expert parallelism over ``ep``).
+
+    Same decoder skeleton; each block's dense MLP becomes a top-1-routed
+    bank of ``moe_experts`` experts. Train with
+    :func:`distkeras_tpu.parallel.spmd.make_moe_lm_train_step` over a
+    (dp, ep) mesh — batch sharded over dp x ep jointly, experts over ep.
+    """
+
+    moe_experts: int = 8

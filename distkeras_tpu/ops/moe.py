@@ -1,0 +1,131 @@
+"""Switch-style mixture-of-experts with expert parallelism (ep).
+
+The reference has no MoE (its models are MLP/CNN-scale; SURVEY.md §2 lists
+expert parallelism as absent). This module is the framework's ep capability:
+top-1 (Switch) routing with per-source capacity, experts sharded over a mesh
+axis, and the canonical two-``all_to_all`` exchange — tokens travel to their
+expert's device and back over ICI, the TPU-native equivalent of the
+all-to-all dispatch in Switch Transformer / GShard.
+
+Everything is dense one-hot matmul dispatch (MXU-friendly, static shapes,
+no gather/scatter), so the whole layer jits into one XLA program. Dropped
+tokens (capacity overflow) contribute zero and ride the residual connection,
+the standard Switch behavior.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def switch_moe(
+    x: jnp.ndarray,          # [S, D] local tokens
+    router_kernel,           # [D, E_global] (replicated)
+    w1, b1,                  # [E_local, D, F], [E_local, F]
+    w2, b2,                  # [E_local, F, D], [E_local, D]
+    ep_size: int = 1,
+    ep_axis: Optional[str] = None,
+    capacity_factor: float = 1.25,
+    dtype=jnp.float32,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Top-1 switch layer. Returns ``(y [S, D], aux_loss scalar)``.
+
+    With ``ep_axis`` set (inside shard_map), each device holds
+    ``E_local = E_global / ep_size`` experts and its own ``S`` tokens;
+    dispatch crosses devices via two ``all_to_all``s. Capacity is
+    ``capacity_factor * S / E_global`` **per source device** — the same
+    number whether sharded or not, which keeps the sharded layer exactly
+    equal to per-source-block unsharded computation (tested).
+
+    The aux term is the Switch load-balancing loss
+    ``E * sum_e(fraction_dispatched_e * mean_router_prob_e)`` over the
+    LOCAL tokens (callers psum/mean it across shards).
+    """
+    S, D = x.shape
+    E_local = w1.shape[0]
+    E = E_local * ep_size
+    C = max(1, int(capacity_factor * S / E))
+
+    logits = (x.astype(jnp.float32) @ router_kernel.astype(jnp.float32))
+    probs = jax.nn.softmax(logits, axis=-1)               # [S, E] f32
+    expert = jnp.argmax(probs, axis=-1)                   # [S]
+    gate = jnp.max(probs, axis=-1)                        # [S]
+
+    onehot = jax.nn.one_hot(expert, E, dtype=jnp.float32)  # [S, E]
+    # rank of each token within its expert's queue (1-based)
+    rank = jnp.cumsum(onehot, axis=0) * onehot
+    keep = (rank > 0) & (rank <= C)
+    dispatch = onehot * keep                              # [S, E]
+    pos = jnp.clip(rank - 1, 0, C - 1).astype(jnp.int32)  # [S, E]
+    # [S, E, C] one-hot over capacity slots for kept tokens
+    dispatch_t = dispatch[..., None] * jax.nn.one_hot(pos, C, dtype=jnp.float32)
+
+    # aux load-balancing loss (Switch eq. 4): fraction of tokens ROUTED to
+    # each expert (pre-capacity) x mean router prob, scaled by E
+    frac = onehot.mean(axis=0)
+    aux = E * jnp.sum(frac * probs.mean(axis=0))
+
+    d = jnp.einsum("sd,sec->ecd", x.astype(jnp.float32), dispatch_t)  # [E, C, D]
+    if ep_axis is not None and ep_size > 1:
+        d = d.reshape(ep_size, E_local, C, D)
+        # axis 0 = destination device → after exchange, axis 0 = source
+        d = jax.lax.all_to_all(d, ep_axis, split_axis=0, concat_axis=0)
+        d = d.transpose(1, 0, 2, 3).reshape(E_local, ep_size * C, D)
+    h = jnp.einsum("ecd,edf->ecf", d.astype(dtype), w1.astype(dtype))
+    h = jax.nn.gelu(h + b1[:, None].astype(dtype))
+    y = jnp.einsum("ecf,efd->ecd", h, w2.astype(dtype))
+    y = (y + b2[:, None].astype(dtype)).astype(jnp.float32)
+    if ep_axis is not None and ep_size > 1:
+        y = y.reshape(E_local, ep_size, C, D).transpose(1, 0, 2, 3)
+        y = jax.lax.all_to_all(y, ep_axis, split_axis=0, concat_axis=0)
+        y = y.reshape(E, C, D)
+    combine_t = dispatch_t * gate[:, None, None]
+    out = jnp.einsum("ecd,sec->sd", y, combine_t)
+    return out.astype(x.dtype), aux.astype(jnp.float32)
+
+
+class SwitchMoE(nn.Module):
+    """Flax wrapper owning the router + expert params.
+
+    ``num_experts`` is GLOBAL; with ``ep_size>1`` the module creates the
+    local ``num_experts/ep_size`` slice (same param names/structure as the
+    ``ep_size=1`` module, so a full-size host init slices onto the mesh via
+    :func:`distkeras_tpu.parallel.spmd.lm_param_specs`).
+    """
+
+    num_experts: int = 8
+    hidden: int = 1024
+    ep_size: int = 1
+    ep_axis: str = "ep"
+    capacity_factor: float = 1.25
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):  # [B, T, D] → ([B, T, D], aux)
+        B, T, D = x.shape
+        E, F = self.num_experts, self.hidden
+        if E % self.ep_size != 0:
+            raise ValueError(
+                f"num_experts={E} not divisible by ep_size={self.ep_size}"
+            )
+        El = E // self.ep_size
+        init = nn.initializers.variance_scaling(1.0, "fan_in", "truncated_normal")
+        router = self.param("router", init, (D, E), jnp.float32)
+        w1 = self.param("w1", init, (El, D, F), jnp.float32)
+        b1 = self.param("b1", nn.initializers.zeros, (El, F), jnp.float32)
+        w2 = self.param("w2", init, (El, F, D), jnp.float32)
+        b2 = self.param("b2", nn.initializers.zeros, (El, D), jnp.float32)
+        y, aux = switch_moe(
+            x.reshape(B * T, D), router, w1, b1, w2, b2,
+            ep_size=self.ep_size,
+            ep_axis=self.ep_axis if self.ep_size > 1 else None,
+            capacity_factor=self.capacity_factor,
+            dtype=self.dtype,
+        )
+        self.sow("intermediates", "moe_aux", aux)
+        return y.reshape(B, T, D)

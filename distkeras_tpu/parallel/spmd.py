@@ -11,10 +11,18 @@ Current axes:
 - ``sp`` — sequence sharding for the language-model step: ring attention
   (:mod:`distkeras_tpu.ops.ring_attention`) plus a ``ppermute`` to fetch
   each shard's next-token target across the shard boundary.
+- ``tp`` — Megatron-style tensor parallelism: heads + MLP hidden sharded
+  per :func:`lm_param_specs`, one forward psum per block pair (inside
+  :class:`~distkeras_tpu.models.transformer.TPDenseGeneral`), backward
+  conjugates inserted by shard_map's vma-aware autodiff.
+- ``ep`` — expert parallelism: Switch-MoE expert banks sharded over ``ep``,
+  tokens exchanged with two ``all_to_all``s
+  (:mod:`distkeras_tpu.ops.moe`), batch sharded over dp x ep jointly.
+- ``pp`` — pipeline parallelism: see :mod:`distkeras_tpu.parallel.pipeline`.
 
 The classifier step (images/labels) uses ``dp`` only and serves any model
-in the zoo; the LM step adds ``sp`` and serves :class:`TransformerLM` built
-with ``attention='ring'``.
+in the zoo; the LM step adds ``sp`` (ring attention) and optionally ``tp``;
+the MoE step runs dp x ep. All are one program text over a named mesh.
 """
 
 from __future__ import annotations
@@ -62,14 +70,84 @@ def make_dp_train_step(apply_fn, loss_fn, optimizer, mesh: Mesh,
     )
 
 
+def lm_param_specs(params, tp_axis: Optional[str] = None,
+                   ep_axis: Optional[str] = None):
+    """PartitionSpec tree for a :class:`TransformerLM` param pytree under
+    tensor and/or expert parallelism: qkv/mlp_up column-sharded, out/
+    mlp_down row-sharded over ``tp_axis`` (matching :class:`TPDenseGeneral`),
+    SwitchMoE expert banks leading-axis-sharded over ``ep_axis`` (router
+    replicated), everything else replicated. Built by parameter *path*, so
+    it works on the full-size host init — shard_map then slices each leaf
+    onto the mesh."""
+    from jax.tree_util import DictKey, tree_map_with_path
+
+    def spec(path, leaf):
+        names = [k.key for k in path if isinstance(k, DictKey)]
+        parent = names[-2] if len(names) >= 2 else ""
+        last = names[-1] if names else ""
+        is_kernel = last == "kernel"
+        if tp_axis is not None:
+            if parent == "qkv":  # kernel [D,3,H,hd], bias [3,H,hd]
+                return (P(None, None, tp_axis, None) if is_kernel
+                        else P(None, tp_axis, None))
+            if parent == "out":  # kernel [H,hd,D], bias [D] (post-psum)
+                return P(tp_axis, None, None) if is_kernel else P()
+            if parent == "mlp_up":  # kernel [D,F], bias [F]
+                return P(None, tp_axis) if is_kernel else P(tp_axis)
+            if parent == "mlp_down":  # kernel [F,D], bias [D] (post-psum)
+                return P(tp_axis, None) if is_kernel else P()
+        if ep_axis is not None and parent == "moe":
+            if last == "router":  # [D, E] replicated (every shard routes)
+                return P()
+            # w1 [E,D,F] / b1 [E,F] / w2 [E,F,D] / b2 [E,D]: experts lead
+            return P(*((ep_axis,) + (None,) * (leaf.ndim - 1)))
+        return P()
+
+    return tree_map_with_path(spec, params)
+
+
+def opt_state_specs(optimizer, params, param_specs):
+    """PartitionSpec tree for ``optimizer.init(params)``: optimizer states
+    embed param-shaped subtrees (mu/nu/trace/...), so each state leaf whose
+    tree path ends with a parameter's path inherits that parameter's spec;
+    scalars (step counts) stay replicated."""
+    from jax.tree_util import tree_flatten_with_path, tree_map_with_path
+
+    flat, _ = tree_flatten_with_path(
+        param_specs, is_leaf=lambda x: isinstance(x, P)
+    )
+    by_path = {tuple(map(repr, path)): s for path, s in flat}
+    shapes = jax.eval_shape(optimizer.init, params)
+
+    def match(path, leaf):
+        keys = tuple(map(repr, path))
+        for i in range(len(keys)):
+            s = by_path.get(keys[i:])
+            if s is not None:
+                return s
+        return P()
+
+    return tree_map_with_path(match, shapes)
+
+
 def make_lm_train_step(model, optimizer, mesh: Mesh,
-                       dp_axis: str = "dp", sp_axis: str = "sp"):
-    """Jitted language-model training step sharded over data x sequence.
+                       dp_axis: str = "dp", sp_axis: str = "sp",
+                       tp_axis: Optional[str] = None,
+                       params_template=None):
+    """Jitted language-model training step sharded over data x sequence
+    (x tensor, optionally).
 
     ``tokens`` is ``[B, T]`` with B sharded over ``dp_axis`` and T over
     ``sp_axis``. The model must be a :class:`TransformerLM` constructed with
     ``attention='ring'`` and ``seq_axis=sp_axis`` so attention is exact over
     the full sequence while each device holds only ``T/sp`` of it.
+
+    With ``tp_axis`` given (and a ``params_template`` for spec inference),
+    the model must also be built with ``tp_size == mesh tp size``: its
+    head/MLP params are sharded per :func:`lm_param_specs`, activations stay
+    replicated over tp, and the module's row-parallel psum plus the
+    vma-transpose collectives shard_map's autodiff inserts make the step
+    exact — one program, dp x sp x tp.
 
     Next-token targets cross the shard boundary: each shard's last position
     is supervised by the *next* shard's first token, fetched with one
@@ -80,6 +158,21 @@ def make_lm_train_step(model, optimizer, mesh: Mesh,
     """
     sp_size = int(np.prod([s for a, s in zip(mesh.axis_names, mesh.devices.shape)
                            if a == sp_axis] or [1]))
+    if tp_axis is None:
+        pspec = ospec = P()
+    else:
+        if params_template is None:
+            raise ValueError(
+                "tensor parallelism needs params_template to infer specs"
+            )
+        tp_size = dict(zip(mesh.axis_names, mesh.devices.shape)).get(tp_axis, 1)
+        if getattr(model, "tp_size", 1) != tp_size:
+            raise ValueError(
+                f"model.tp_size={getattr(model, 'tp_size', 1)} != mesh "
+                f"{tp_axis} size {tp_size}"
+            )
+        pspec = lm_param_specs(params_template, tp_axis=tp_axis)
+        ospec = opt_state_specs(optimizer, params_template, pspec)
 
     def device_step(params, opt_state, tokens):
         B_l, T_l = tokens.shape
@@ -117,7 +210,72 @@ def make_lm_train_step(model, optimizer, mesh: Mesh,
         shard_map(
             device_step,
             mesh=mesh,
-            in_specs=(P(), P(), P(dp_axis, sp_axis)),
-            out_specs=(P(), P(), P()),
+            in_specs=(pspec, ospec, P(dp_axis, sp_axis)),
+            out_specs=(pspec, ospec, P()),
+        )
+    )
+
+
+def make_moe_lm_train_step(model, optimizer, mesh: Mesh,
+                           dp_axis: str = "dp", ep_axis: str = "ep",
+                           params_template=None, aux_weight: float = 0.01):
+    """Jitted MoE language-model step over a (dp, ep) mesh.
+
+    ``tokens [B, T]`` is sharded over BOTH axes jointly (``P((dp, ep))``) —
+    every device carries its own tokens AND its slice of the expert banks,
+    so expert capacity scales with the mesh instead of replicating work.
+    Routing crosses devices inside the model via the SwitchMoE layer's two
+    ``all_to_all``s over ``ep_axis``; everything else is plain data
+    parallelism.
+
+    Loss = global mean next-token cross-entropy + ``aux_weight`` x the mean
+    Switch load-balancing loss (collected from the modules' sown
+    intermediates).
+
+    Returns ``step(params, opt_state, tokens) -> (params, opt_state, loss)``.
+    """
+    if params_template is None:
+        raise ValueError("MoE step needs params_template to infer specs")
+    ax = dict(zip(mesh.axis_names, mesh.devices.shape))
+    ep_size = ax.get(ep_axis, 1)
+    if getattr(model, "ep_size", 1) != ep_size:
+        raise ValueError(
+            f"model.ep_size={getattr(model, 'ep_size', 1)} != mesh "
+            f"{ep_axis} size {ep_size}"
+        )
+    pspec = lm_param_specs(params_template, ep_axis=ep_axis)
+    ospec = opt_state_specs(optimizer, params_template, pspec)
+    n_shards = ax.get(dp_axis, 1) * ep_size
+
+    def device_step(params, opt_state, tokens):
+        def objective(p):
+            logits, state = model.apply(
+                p, tokens, mutable=["intermediates"]
+            )
+            ce = optax.softmax_cross_entropy_with_integer_labels(
+                logits[:, :-1], tokens[:, 1:]
+            ).mean()
+            aux_leaves = jax.tree.leaves(state.get("intermediates", {}))
+            aux = sum(jnp.sum(a) for a in aux_leaves) / max(len(aux_leaves), 1)
+            return ce + aux_weight * aux, ce
+
+        (local_obj, local_ce), grads = jax.value_and_grad(
+            objective, has_aux=True
+        )(params)
+        # every shard weighs equally (same local token count): global mean
+        # objective = mean of local objectives; autodiff's vma transpose
+        # already psums grads of the replicated params over (dp, ep)
+        grads = rules.tree_scale(grads, 1.0 / n_shards)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        loss = jax.lax.pmean(local_ce, (dp_axis, ep_axis))
+        return params, opt_state, loss
+
+    return jax.jit(
+        shard_map(
+            device_step,
+            mesh=mesh,
+            in_specs=(pspec, ospec, P((dp_axis, ep_axis))),
+            out_specs=(pspec, ospec, P()),
         )
     )
