@@ -243,7 +243,11 @@ class TransformerLM(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, train: bool = False):
-        x = nn.Embed(self.vocab_size, self.d_model, dtype=self.dtype)(tokens)
+        # explicit submodule names: the pipeline-parallel path addresses
+        # param subtrees by name (parallel/pipeline.py), so these are API
+        x = nn.Embed(
+            self.vocab_size, self.d_model, dtype=self.dtype, name="embed"
+        )(tokens)
         # With ring attention each shard holds a T/sp slice of the sequence,
         # so positions must be *global*: shard_index * T_local + local offset.
         pos_table = jnp.asarray(sinusoidal_positions(self.max_len, self.d_model))
@@ -252,7 +256,7 @@ class TransformerLM(nn.Module):
             offset = jax.lax.axis_index(self.seq_axis) * x.shape[1]
             local_pos = local_pos + offset
         x = x + jnp.take(pos_table, local_pos, axis=0)[None].astype(self.dtype)
-        for _ in range(self.num_layers):
+        for i in range(self.num_layers):
             x = Block(
                 self.num_heads,
                 dtype=self.dtype,
@@ -263,9 +267,10 @@ class TransformerLM(nn.Module):
                 moe_experts=self.moe_experts,
                 ep_size=self.ep_size,
                 ep_axis=self.ep_axis,
+                name=f"Block_{i}",
             )(x)
-        x = nn.LayerNorm(dtype=self.dtype)(x)
-        return nn.Dense(self.vocab_size, dtype=jnp.float32)(x)
+        x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
+        return nn.Dense(self.vocab_size, dtype=jnp.float32, name="head")(x)
 
 
 @register_model("moe_lm")

@@ -106,7 +106,9 @@ class SwitchMoE(nn.Module):
     dtype: jnp.dtype = jnp.bfloat16
 
     @nn.compact
-    def __call__(self, x):  # [B, T, D] → ([B, T, D], aux)
+    def __call__(self, x):  # [B, T, D] -> [B, T, D]; aux is SOWN
+        # into the 'intermediates' collection (read it via
+        # apply(..., mutable=['intermediates']), as the MoE train step does)
         B, T, D = x.shape
         E, F = self.num_experts, self.hidden
         if E % self.ep_size != 0:

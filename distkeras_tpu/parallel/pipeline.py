@@ -1,0 +1,189 @@
+"""Pipeline parallelism (pp) — GPipe-style microbatch pipeline.
+
+The reference has no pipeline parallelism (SURVEY.md §2's strategy
+checklist: absent). This module adds it the SPMD way: the transformer's
+layer stack is split into ``pp`` contiguous stages, each stage's block
+parameters live on one mesh slice (leading-axis sharding of a stacked
+layer pytree), and microbatches flow stage-to-stage with one ``ppermute``
+per schedule tick. The whole schedule — fill, steady state, drain —
+is a single ``lax.scan`` inside ``shard_map``; the backward schedule falls
+out of autodiff (the transpose of ``ppermute`` is the reverse rotation),
+so one program text trains the pipeline.
+
+Bubble math: ``M`` microbatches over ``pp`` stages run ``M + pp - 1``
+ticks, the standard GPipe bubble fraction ``(pp-1)/(M+pp-1)`` — pick
+``M >= 4*pp`` to keep it small. Every stage also computes the (cheap)
+embedding/head each tick and masks the result; that trades a few MXU
+cycles for zero cross-stage control flow, the right trade on TPU.
+
+Composes with ``dp`` (batch axis of each microbatch sharded over dp).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from distkeras_tpu.ops import rules
+
+
+def to_pipeline_params(params, num_layers: int):
+    """TransformerLM params → ``{'blocks': stacked [L, ...], 'rest': ...}``.
+
+    The stacked representation is what shards over ``pp`` (leading axis);
+    ``rest`` (embed, final LN, head) is replicated — every stage holds it,
+    only the first/last stages use it.
+    """
+    p = params["params"]
+    blocks = [p[f"Block_{i}"] for i in range(num_layers)]
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *blocks)
+    rest = {k: v for k, v in p.items() if not k.startswith("Block_")}
+    return {"blocks": stacked, "rest": rest}
+
+
+def from_pipeline_params(pp_params, num_layers: int):
+    """Inverse of :func:`to_pipeline_params` (host-side, for comparing or
+    exporting back to the plain module layout)."""
+    out = dict(pp_params["rest"])
+    for i in range(num_layers):
+        out[f"Block_{i}"] = jax.tree.map(
+            lambda x, i=i: np.asarray(x[i]), pp_params["blocks"]
+        )
+    return {"params": out}
+
+
+def pipeline_param_specs(template, pp_axis: str = "pp"):
+    blocks = jax.tree.map(
+        lambda x: P(*((pp_axis,) + (None,) * (x.ndim - 1))),
+        template["blocks"],
+    )
+    rest = jax.tree.map(lambda x: P(), template["rest"])
+    return {"blocks": blocks, "rest": rest}
+
+
+def make_pp_lm_train_step(model, optimizer, mesh: Mesh,
+                          params_template,
+                          pp_axis: str = "pp", dp_axis: str = "dp"):
+    """Jitted pipeline-parallel LM training step over a (pp, dp) mesh.
+
+    ``model`` is a plain single-chip :class:`TransformerLM`
+    (``attention='standard'|'dense'``, ``tp_size=1``); its ``num_layers``
+    must divide the mesh's ``pp`` size evenly. ``params_template`` is the
+    full-size host init (the plain module layout); the returned step takes
+    the PIPELINE layout from :func:`to_pipeline_params`.
+
+    ``tokens`` is ``[M, B, T]`` — M microbatches, batch sharded over
+    ``dp_axis``. Returns
+    ``step(pp_params, opt_state, tokens) -> (pp_params, opt_state, loss)``
+    with loss the global mean next-token cross-entropy.
+    """
+    from distkeras_tpu.models.transformer import Block, sinusoidal_positions
+    from distkeras_tpu.parallel.spmd import opt_state_specs
+
+    ax = dict(zip(mesh.axis_names, mesh.devices.shape))
+    pp = ax.get(pp_axis, 1)
+    dp = ax.get(dp_axis, 1)
+    L = model.num_layers
+    if L % pp != 0:
+        raise ValueError(f"num_layers={L} not divisible by pp={pp}")
+    if (getattr(model, "tp_size", 1) != 1 or model.attention == "ring"
+            or getattr(model, "moe_experts", 0) > 0):
+        raise ValueError(
+            "pipeline step takes a plain single-chip TransformerLM "
+            "(tp_size=1, non-ring attention, no MoE); compose dp instead"
+        )
+
+    template = to_pipeline_params(params_template, L)
+    pspec = pipeline_param_specs(template, pp_axis)
+    ospec = opt_state_specs(optimizer, template, pspec)
+
+    block_mod = Block(model.num_heads, dtype=model.dtype,
+                      attention=model.attention)
+    embed_mod = nn.Embed(model.vocab_size, model.d_model, dtype=model.dtype)
+    ln_mod = nn.LayerNorm(dtype=model.dtype)
+    head_mod = nn.Dense(model.vocab_size, dtype=jnp.float32)
+    pos_table = sinusoidal_positions(model.max_len, model.d_model)
+
+    def device_step(params, opt_state, tokens):
+        M, B_l, T = tokens.shape
+        my = jax.lax.axis_index(pp_axis)
+
+        def objective(p):
+            def embed_one(tok):
+                x = embed_mod.apply({"params": p["rest"]["embed"]}, tok)
+                return x + jnp.asarray(pos_table)[None, :T].astype(model.dtype)
+
+            def stage(x):
+                def body(x, bp):
+                    return block_mod.apply({"params": bp}, x), None
+
+                x, _ = jax.lax.scan(body, x, p["blocks"])
+                return x
+
+            def head(x):
+                x = ln_mod.apply({"params": p["rest"]["ln_f"]}, x)
+                return head_mod.apply({"params": p["rest"]["head"]}, x)
+
+            emb_all = jax.vmap(embed_one)(tokens)  # [M, B_l, T, D]
+            perm = [(d, (d + 1) % pp) for d in range(pp)]
+            # initial carries are constants (vma {}) but the loop makes
+            # them device-varying; pcast declares that up front so the
+            # scan carry types match
+            x0 = jax.lax.pcast(
+                jnp.zeros((B_l, T, model.d_model), model.dtype),
+                (pp_axis, dp_axis), to="varying",
+            )
+            ce0 = jax.lax.pcast(
+                jnp.zeros((), jnp.float32), (pp_axis, dp_axis), to="varying"
+            )
+
+            def tick(carry, t):
+                # per-tick loss accumulation: each microbatch's logits are
+                # consumed the tick they exit the pipe, so no [M,B,T,vocab]
+                # buffer ever exists (that buffer is O(GB) at real sizes)
+                x_cur, ce_sum = carry
+                prev = jax.lax.ppermute(x_cur, pp_axis, perm)
+                feed = jax.lax.dynamic_index_in_dim(
+                    emb_all, jnp.clip(t, 0, M - 1), 0, keepdims=False
+                )
+                x_in = jnp.where(my == 0, feed, prev)
+                y = stage(x_in)
+                logits = head(y)  # meaningful on the last stage only
+                out_idx = jnp.clip(t - (pp - 1), 0, M - 1)
+                mb_tokens = jax.lax.dynamic_index_in_dim(
+                    tokens, out_idx, 0, keepdims=False
+                )
+                ce = optax.softmax_cross_entropy_with_integer_labels(
+                    logits[:, :-1], mb_tokens[:, 1:]
+                ).sum()
+                valid = (my == pp - 1) & (t >= pp - 1)
+                ce_sum = ce_sum + jnp.where(valid, ce, 0.0)
+                return (y, ce_sum), None
+
+            (_, ce_sum), _ = jax.lax.scan(
+                tick, (x0, ce0), jnp.arange(M + pp - 1)
+            )
+            # ce_sum is real on the last stage only; psum selects it
+            return jax.lax.psum(ce_sum, pp_axis) / (M * B_l * (T - 1))
+
+        loss, grads = jax.value_and_grad(objective)(params)
+        grads = rules.tree_scale(grads, 1.0 / dp)  # global batch mean
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, jax.lax.pmean(loss, dp_axis)
+
+    return jax.jit(
+        shard_map(
+            device_step,
+            mesh=mesh,
+            in_specs=(pspec, ospec, P(None, dp_axis, None)),
+            out_specs=(pspec, ospec, P()),
+        )
+    )

@@ -243,6 +243,11 @@ def make_moe_lm_train_step(model, optimizer, mesh: Mesh,
             f"model.ep_size={getattr(model, 'ep_size', 1)} != mesh "
             f"{ep_axis} size {ep_size}"
         )
+    if getattr(model, "tp_size", 1) != 1:
+        raise ValueError(
+            "the MoE step shards ep only; build the model with tp_size=1 "
+            "(tp x ep composition is not supported here)"
+        )
     pspec = lm_param_specs(params_template, ep_axis=ep_axis)
     ospec = opt_state_specs(optimizer, params_template, pspec)
     n_shards = ax.get(dp_axis, 1) * ep_size

@@ -1,0 +1,109 @@
+"""Pipeline parallelism: the GPipe microbatch schedule over (pp, dp) must
+reproduce the unsharded model's loss and updates exactly."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from distkeras_tpu.models import get_model
+from distkeras_tpu.parallel.mesh import make_mesh
+from distkeras_tpu.parallel.pipeline import (
+    from_pipeline_params,
+    make_pp_lm_train_step,
+    to_pipeline_params,
+)
+
+LM_KW = dict(vocab_size=64, d_model=32, num_heads=2, num_layers=4,
+             max_len=16, dtype=jnp.float32)
+M, B, T = 8, 4, 16  # microbatches, per-microbatch batch, seq len
+
+
+def setup(pp, dp, seed=0):
+    mesh = make_mesh({"pp": pp, "dp": dp})
+    model = get_model("transformer_lm", attention="standard", **LM_KW)
+    tokens = jnp.asarray(
+        np.random.default_rng(seed).integers(0, 64, size=(M, B, T)), jnp.int32
+    )
+    params = model.init(jax.random.PRNGKey(0), tokens[0])
+    return mesh, model, tokens, params
+
+
+def ref_loss_and_step(model, params, tokens, optimizer):
+    def loss_fn(p):
+        logits = jax.vmap(lambda t: model.apply(p, t))(tokens)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits[:, :, :-1], tokens[:, :, 1:]
+        ).mean()
+
+    loss, grads = jax.value_and_grad(loss_fn)(params)
+    updates, _ = optimizer.update(grads, optimizer.init(params), params)
+    return float(loss), optax.apply_updates(params, updates)
+
+
+def test_pp_loss_matches_unsharded():
+    mesh, model, tokens, params = setup(pp=4, dp=2)
+    optimizer = optax.sgd(0.1)
+    step = make_pp_lm_train_step(model, optimizer, mesh, params)
+    ppp = to_pipeline_params(params, LM_KW["num_layers"])
+    _, _, loss = step(ppp, optimizer.init(ppp), tokens)
+    ref, _ = ref_loss_and_step(model, params, tokens, optimizer)
+    np.testing.assert_allclose(float(loss), ref, rtol=1e-5)
+
+
+def test_pp_step_params_match_unsharded_step():
+    mesh, model, tokens, params = setup(pp=4, dp=2, seed=3)
+    optimizer = optax.sgd(0.1)
+    step = make_pp_lm_train_step(model, optimizer, mesh, params)
+    ppp = to_pipeline_params(params, LM_KW["num_layers"])
+    new_pp, _, _ = step(ppp, optimizer.init(ppp), tokens)
+    _, p_ref = ref_loss_and_step(model, params, tokens, optimizer)
+
+    restored = from_pipeline_params(
+        jax.tree.map(np.asarray, new_pp), LM_KW["num_layers"]
+    )
+    ref_flat = dict(
+        (jax.tree_util.keystr(k), v)
+        for k, v in jax.tree_util.tree_leaves_with_path(p_ref)
+    )
+    for key, leaf in jax.tree_util.tree_leaves_with_path(restored):
+        np.testing.assert_allclose(
+            np.asarray(leaf), np.asarray(ref_flat[jax.tree_util.keystr(key)]),
+            rtol=2e-4, atol=2e-5, err_msg=jax.tree_util.keystr(key),
+        )
+
+
+def test_pp_trains():
+    mesh, model, tokens, params = setup(pp=4, dp=2, seed=1)
+    optimizer = optax.adam(1e-2)
+    step = make_pp_lm_train_step(model, optimizer, mesh, params)
+    p = to_pipeline_params(params, LM_KW["num_layers"])
+    s = optimizer.init(p)
+    losses = []
+    for _ in range(15):
+        p, s, loss = step(p, s, tokens)
+        losses.append(float(loss))
+    assert np.isfinite(losses).all()
+    # random tokens: floor is ln(64) ~= 4.16 until memorization kicks in,
+    # so assert a solid absolute decrease rather than a ratio
+    assert losses[-1] < losses[0] - 0.3, losses
+
+
+def test_pp_rejects_bad_configs():
+    mesh = make_mesh({"pp": 4, "dp": 2})
+    import pytest
+
+    model = get_model("transformer_lm", attention="standard",
+                      **dict(LM_KW, num_layers=3))
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, T), jnp.int32)
+    )
+    with pytest.raises(ValueError, match="not divisible"):
+        make_pp_lm_train_step(model, optax.sgd(0.1), mesh, params)
+
+    ring = get_model("transformer_lm", attention="ring", **LM_KW)
+    params4 = get_model("transformer_lm", attention="standard", **LM_KW).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, T), jnp.int32)
+    )
+    with pytest.raises(ValueError, match="plain single-chip"):
+        make_pp_lm_train_step(ring, optax.sgd(0.1), mesh, params4)
