@@ -9,3 +9,8 @@ from distkeras_tpu.utils.serde import (  # noqa: F401
 from distkeras_tpu.utils.losses import get_loss, get_metric  # noqa: F401
 from distkeras_tpu.utils.history import average_histories  # noqa: F401
 from distkeras_tpu.utils.initializers import uniform_weights  # noqa: F401
+from distkeras_tpu.utils.keras_import import (  # noqa: F401
+    from_keras,
+    from_keras_config,
+    keras_available,
+)

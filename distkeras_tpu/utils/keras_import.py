@@ -1,0 +1,251 @@
+"""Keras model importer — the reference user's migration path.
+
+Reference: distkeras/utils.py · serialize_keras_model /
+deserialize_keras_model — the reference's entire model interchange format is
+``{'model': model.to_json(), 'weights': model.get_weights()}``. A user
+switching to this framework holds exactly that: a Keras ``Sequential``
+(the reference's examples are all Sequential MLPs/CNNs) plus a weight list.
+
+This module converts that into the framework's native ``(flax module,
+params)`` pair:
+
+- :func:`from_keras` — import a live Keras model object (Keras 3 is in the
+  image; gated, so environments without it still import this module);
+- :func:`from_keras_config` — import from the *config dict + weight list*
+  alone, no Keras/TF needed (works on the output of
+  ``json.loads(model.to_json())['config']`` — i.e. on the reference's own
+  serialization format).
+
+Supported layers (the reference's example vocabulary): Dense, Conv2D,
+Flatten, Reshape, MaxPooling2D, AveragePooling2D, Dropout (identity —
+framework losses regularize elsewhere), Activation/ReLU/Softmax,
+InputLayer. Anything else raises with the layer name so the user knows
+what to port by hand.
+
+Training note: the reference's models end in ``softmax`` and train with
+Keras' probability-input crossentropy; this framework's losses fold the
+softmax into the loss (logits in, XLA-fused). Import with
+``strip_final_softmax=True`` to drop a trailing softmax for training with
+the native losses; leave it False for bit-faithful inference parity.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distkeras_tpu.models.registry import register_model
+
+_ACTIVATIONS = {
+    "linear": lambda x: x,
+    None: lambda x: x,
+    "relu": nn.relu,
+    "tanh": jnp.tanh,
+    "sigmoid": nn.sigmoid,
+    "gelu": nn.gelu,
+    "elu": nn.elu,
+    "softmax": lambda x: nn.softmax(x, axis=-1),
+}
+
+
+def _act(name):
+    try:
+        return _ACTIVATIONS[name]
+    except KeyError:
+        raise ValueError(
+            f"Unsupported Keras activation '{name}'. "
+            f"Known: {sorted(k for k in _ACTIVATIONS if k)}"
+        ) from None
+
+
+@register_model("keras_imported")
+class KerasImported(nn.Module):
+    """Sequential stack rebuilt from a Keras config.
+
+    ``layers`` is a hashable tuple of ``(kind, (("key", value), ...))``
+    pairs (hashability keeps flax module equality/compile-sharing intact).
+    Parameterized layers are named ``layer_{i}`` by their position, which
+    is the contract :func:`build_params` fills weights against.
+    """
+
+    layers: Tuple[Tuple[str, Tuple], ...] = ()
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = jnp.asarray(x, jnp.float32)
+        for i, (kind, cfg_items) in enumerate(self.layers):
+            cfg = dict(cfg_items)
+            name = f"layer_{i}"
+            if kind == "dense":
+                x = nn.Dense(
+                    cfg["units"], use_bias=cfg.get("use_bias", True),
+                    name=name,
+                )(x)
+                x = _act(cfg.get("activation"))(x)
+            elif kind == "conv2d":
+                x = nn.Conv(
+                    cfg["filters"],
+                    kernel_size=tuple(cfg["kernel_size"]),
+                    strides=tuple(cfg.get("strides", (1, 1))),
+                    padding=cfg.get("padding", "valid").upper(),
+                    use_bias=cfg.get("use_bias", True),
+                    name=name,
+                )(x)
+                x = _act(cfg.get("activation"))(x)
+            elif kind == "flatten":
+                x = x.reshape((x.shape[0], -1))
+            elif kind == "reshape":
+                x = x.reshape((x.shape[0],) + tuple(cfg["target_shape"]))
+            elif kind == "maxpool2d":
+                p = tuple(cfg.get("pool_size", (2, 2)))
+                s = tuple(cfg.get("strides") or p)
+                x = nn.max_pool(x, window_shape=p, strides=s,
+                                padding=cfg.get("padding", "valid").upper())
+            elif kind == "avgpool2d":
+                p = tuple(cfg.get("pool_size", (2, 2)))
+                s = tuple(cfg.get("strides") or p)
+                x = nn.avg_pool(x, window_shape=p, strides=s,
+                                padding=cfg.get("padding", "valid").upper())
+            elif kind == "activation":
+                x = _act(cfg.get("activation"))(x)
+            elif kind == "dropout":
+                pass  # identity at inference; framework trains without it
+            else:
+                raise ValueError(f"Unsupported imported layer kind '{kind}'")
+        return x
+
+
+_KERAS_KIND = {
+    "Dense": "dense",
+    "Conv2D": "conv2d",
+    "Flatten": "flatten",
+    "Reshape": "reshape",
+    "MaxPooling2D": "maxpool2d",
+    "AveragePooling2D": "avgpool2d",
+    "Activation": "activation",
+    "ReLU": "activation",
+    "Softmax": "activation",
+    "Dropout": "dropout",
+}
+
+_KEPT_KEYS = {
+    "dense": ("units", "activation", "use_bias"),
+    "conv2d": ("filters", "kernel_size", "strides", "padding",
+               "activation", "use_bias"),
+    "reshape": ("target_shape",),
+    "maxpool2d": ("pool_size", "strides", "padding"),
+    "avgpool2d": ("pool_size", "strides", "padding"),
+    "activation": ("activation",),
+    "flatten": (),
+    "dropout": (),
+}
+
+
+def _freeze(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(_freeze(x) for x in v)
+    return v
+
+
+def keras_config_to_spec(
+    config: Dict[str, Any], strip_final_softmax: bool = False
+) -> Tuple[Tuple[str, Tuple], ...]:
+    """Keras ``Sequential`` config dict → hashable layer spec tuple."""
+    layer_cfgs = config.get("layers")
+    if layer_cfgs is None:
+        raise ValueError(
+            "expected a Sequential config with a 'layers' list; functional "
+            "graphs are not supported — rebuild with the native model zoo"
+        )
+    spec: List[Tuple[str, Tuple]] = []
+    for lc in layer_cfgs:
+        cls = lc["class_name"]
+        if cls in ("InputLayer",):
+            continue
+        kind = _KERAS_KIND.get(cls)
+        if kind is None:
+            raise ValueError(
+                f"Unsupported Keras layer '{cls}'. Supported: "
+                f"{sorted(_KERAS_KIND)}"
+            )
+        cfg = lc.get("config", {})
+        if cls == "ReLU":
+            cfg = {"activation": "relu"}
+        elif cls == "Softmax":
+            cfg = {"activation": "softmax"}
+        kept = {
+            k: _freeze(cfg[k]) for k in _KEPT_KEYS[kind] if k in cfg
+        }
+        spec.append((kind, tuple(sorted(kept.items()))))
+    if strip_final_softmax and spec:
+        kind, items = spec[-1]
+        cfg = dict(items)
+        if cfg.get("activation") == "softmax":
+            if kind == "activation":
+                spec.pop()
+            else:
+                cfg["activation"] = "linear"
+                spec[-1] = (kind, tuple(sorted(cfg.items())))
+    return tuple(spec)
+
+
+def build_params(spec, weights: Sequence[np.ndarray]) -> Dict[str, Any]:
+    """Fill the module's param tree from a Keras ``get_weights()`` list
+    (kernel-then-bias per parameterized layer — Keras' own order; layouts
+    match flax: Dense [in,out], Conv2D [kh,kw,in,out] channels-last)."""
+    weights = list(weights)
+    params: Dict[str, Any] = {}
+    for i, (kind, cfg_items) in enumerate(spec):
+        if kind not in ("dense", "conv2d"):
+            continue
+        cfg = dict(cfg_items)
+        entry = {"kernel": jnp.asarray(weights.pop(0), jnp.float32)}
+        if cfg.get("use_bias", True):
+            entry["bias"] = jnp.asarray(weights.pop(0), jnp.float32)
+        params[f"layer_{i}"] = entry
+    if weights:
+        raise ValueError(
+            f"{len(weights)} leftover weight arrays after filling the spec "
+            "— layer/weight mismatch (BatchNorm or other stateful layers?)"
+        )
+    return {"params": params}
+
+
+def from_keras_config(
+    config: Dict[str, Any],
+    weights: Sequence[np.ndarray],
+    strip_final_softmax: bool = False,
+):
+    """(Sequential config dict, weight list) → framework ``Model``.
+
+    Works without Keras installed — this is the pure-data path for the
+    reference's ``{'model': to_json(), 'weights': get_weights()}`` format:
+    pass ``json.loads(blob['model'])['config']`` and ``blob['weights']``.
+    """
+    from distkeras_tpu.models.wrapper import Model
+
+    spec = keras_config_to_spec(config, strip_final_softmax)
+    module = KerasImported(layers=spec)
+    return Model(module, build_params(spec, weights))
+
+
+def from_keras(keras_model, strip_final_softmax: bool = False):
+    """Live Keras model → framework ``Model`` (requires keras importable)."""
+    return from_keras_config(
+        keras_model.get_config(),
+        keras_model.get_weights(),
+        strip_final_softmax=strip_final_softmax,
+    )
+
+
+def keras_available() -> bool:
+    try:
+        import keras  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
