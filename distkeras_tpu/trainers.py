@@ -916,3 +916,138 @@ class DataParallelTrainer(Trainer):
         self.history = history
         self.executor_histories = [history]
         return Model(self.model, params)
+
+
+class LMTrainer(Trainer):
+    """Flagship long-context path as a Trainer: a :class:`TransformerLM`
+    trained over a dp x sp (x tp) mesh with the SPMD LM step
+    (:func:`distkeras_tpu.parallel.spmd.make_lm_train_step`).
+
+    No reference counterpart (the reference has no sequence models); this
+    folds the framework's headline capability — ring-attention sequence
+    parallelism + optional Megatron tensor parallelism — into the same
+    Trainer API (checkpointing, JSONL metrics, timing, history) every
+    other trainer speaks.
+
+    Data contract: the dataset carries a ``tokens_col`` column of int
+    token ids ``[N, T]``; each step consumes a ``[batch_size, T]`` global
+    batch sharded batch-over-dp, sequence-over-sp. The loss is the global
+    mean next-token cross-entropy (``loss``/``metrics``/``label_col``
+    kwargs are ignored — an LM supervises itself).
+    """
+
+    def __init__(self, model, *args, axes: Optional[dict] = None,
+                 tokens_col: str = "tokens", **kwargs):
+        super().__init__(model, *args, **kwargs)
+        self.axes = axes  # e.g. {"dp": 4, "sp": 2} or {"dp": 2, "sp": 2, "tp": 2}
+        self.tokens_col = tokens_col
+
+    def _init_params(self, tokens: np.ndarray, sp: int):
+        """Full-size host init via a standard-attention twin (ring
+        attention only traces inside shard_map with the axis bound); the
+        twin's param tree is identical, and the SPMD step slices any
+        tp-sharded leaves onto the mesh."""
+        if self.params is not None:
+            return self.params
+        from distkeras_tpu.models import get_model
+        from distkeras_tpu.models.registry import model_spec
+
+        spec = model_spec(self.model)
+        kwargs = dict(spec["kwargs"])
+        kwargs.update(attention="standard", tp_size=1)
+        twin = get_model(spec["name"], **kwargs)
+        T_local = tokens.shape[1] // sp
+        self.params = twin.init(
+            jax.random.PRNGKey(self.seed),
+            jnp.asarray(tokens[:1, :T_local], jnp.int32),
+        )
+        return self.params
+
+    def _train(self, dataset: PartitionedDataset, shuffle: bool = False) -> Model:
+        from distkeras_tpu.parallel.mesh import make_mesh
+        from distkeras_tpu.parallel.spmd import make_lm_train_step
+        from jax.sharding import NamedSharding
+
+        if shuffle:
+            dataset = dataset.shuffle(seed=self.seed)
+        axes = dict(self.axes) if self.axes else {"dp": len(jax.devices())}
+        mesh = make_mesh(axes)
+        sp = axes.get("sp", 1)
+        tp = axes.get("tp", 1)
+        if sp > 1 and self.model.attention != "ring":
+            raise ValueError(
+                "sp > 1 needs the model built with attention='ring' "
+                "(seq_axis='sp')"
+            )
+        if getattr(self.model, "tp_size", 1) != tp:
+            raise ValueError(
+                f"model.tp_size={getattr(self.model, 'tp_size', 1)} != "
+                f"mesh tp size {tp}"
+            )
+
+        tokens = np.asarray(dataset.column(self.tokens_col))
+        if tokens.ndim != 2:
+            raise ValueError(
+                f"'{self.tokens_col}' must be [N, T] int token ids, got "
+                f"shape {tokens.shape}"
+            )
+        if tokens.shape[1] % max(sp, 1) != 0:
+            raise ValueError(
+                f"sequence length {tokens.shape[1]} not divisible by sp={sp}"
+            )
+        self._init_params(tokens, sp)
+
+        optimizer = get_optimizer(self.worker_optimizer, self.learning_rate)
+        step = make_lm_train_step(
+            self.model, optimizer, mesh,
+            tp_axis="tp" if tp > 1 else None,
+            params_template=self.params if tp > 1 else None,
+        )
+
+        B = self.batch_size
+        n = (len(tokens) // B) * B
+        if n == 0:
+            raise ValueError(
+                f"dataset of {len(tokens)} rows is smaller than "
+                f"batch_size={B}"
+            )
+        batches = tokens[:n].reshape(-1, B, tokens.shape[1]).astype(np.int32)
+
+        params = self.params
+        opt_state = optimizer.init(params)
+        start_epoch = 0
+        if self.checkpointer is not None:
+            ck_step, state = self.checkpointer.restore(like={
+                "params": params, "opt_state": opt_state,
+                "extra": {"epoch": 0},
+            })
+            if state is not None:
+                params = state["params"]
+                opt_state = state["opt_state"] or opt_state
+                start_epoch = int(state["extra"].get("epoch", ck_step))
+
+        token_sharding = NamedSharding(
+            mesh, P("dp", "sp") if sp > 1 else P("dp")
+        )
+        history: History = []
+        for epoch in range(start_epoch, self.num_epoch):
+            for b in range(len(batches)):
+                xb = jax.device_put(batches[b], token_sharding)
+                params, opt_state, loss = step(params, opt_state, xb)
+                row = {"loss": float(loss)}
+                history.append(row)
+                if self.metrics_writer is not None:
+                    self.metrics_writer.log(
+                        step=len(history), samples=B * tokens.shape[1], **row
+                    )
+            if self.checkpointer is not None:
+                self.checkpointer.maybe_save(
+                    epoch + 1, jax.tree.map(np.asarray, params),
+                    jax.tree.map(np.asarray, opt_state),
+                    extra={"epoch": epoch + 1},
+                    force=(epoch + 1 == self.num_epoch),
+                )
+        self.params = jax.tree.map(np.asarray, params)
+        self.history = history
+        self.executor_histories = [history]
+        return Model(self.model, self.params)

@@ -1,0 +1,99 @@
+"""Long-context LM training example — the flagship multi-axis workload.
+
+No reference counterpart (dist-keras has no sequence models); this shows
+the capability the TPU rebuild adds: a TransformerLM trained through the
+same Trainer API as every reference algorithm, sharded over whichever mesh
+axes the hardware offers:
+
+    # one chip (or CPU):
+    python examples/lm_training.py
+
+    # 8 devices, batch x sequence (ring attention):
+    python examples/lm_training.py --dp 4 --sp 2
+
+    # 8 devices, batch x sequence x tensor (Megatron sharding):
+    python examples/lm_training.py --dp 2 --sp 2 --tp 2
+
+Zero-egress: trains on a synthetic token corpus with learnable structure
+(a noisy repeating pattern — loss well below the uniform floor proves
+learning). Pass --metrics out.jsonl for per-step JSONL observability.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+
+def synthetic_corpus(n, T, vocab, seed=0):
+    """Noisy periodic token streams: next-token is predictable, so the
+    loss floor is far below ln(vocab)."""
+    rng = np.random.default_rng(seed)
+    period = 8
+    base = rng.integers(0, vocab, size=(n, period))
+    reps = -(-T // period)
+    tokens = np.tile(base, (1, reps))[:, :T]
+    noise = rng.random(size=tokens.shape) < 0.05
+    tokens[noise] = rng.integers(0, vocab, size=int(noise.sum()))
+    return tokens.astype(np.int32)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dp", type=int, default=0, help="0 = all devices")
+    ap.add_argument("--sp", type=int, default=1)
+    ap.add_argument("--tp", type=int, default=1)
+    ap.add_argument("--n", type=int, default=512, help="corpus sequences")
+    ap.add_argument("--seq-len", type=int, default=256)
+    ap.add_argument("--vocab", type=int, default=256)
+    ap.add_argument("--d-model", type=int, default=128)
+    ap.add_argument("--layers", type=int, default=2)
+    ap.add_argument("--heads", type=int, default=4)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--metrics", default=None, help="JSONL metrics path")
+    args = ap.parse_args()
+
+    import jax
+
+    from distkeras_tpu import PartitionedDataset
+    from distkeras_tpu.models import get_model
+    from distkeras_tpu.trainers import LMTrainer
+
+    dp = args.dp or (len(jax.devices()) // (args.sp * args.tp))
+    axes = {"dp": dp, "sp": args.sp, "tp": args.tp}
+    axes = {k: v for k, v in axes.items() if v > 1} or {"dp": 1}
+
+    tokens = synthetic_corpus(args.n, args.seq_len, args.vocab)
+    ds = PartitionedDataset.from_arrays({"tokens": tokens}, num_partitions=1)
+
+    model = get_model(
+        "transformer_lm",
+        vocab_size=args.vocab, d_model=args.d_model, num_heads=args.heads,
+        num_layers=args.layers, max_len=args.seq_len,
+        attention="ring" if args.sp > 1 else "standard",
+        seq_axis="sp", tp_size=args.tp, tp_axis="tp",
+    )
+    trainer = LMTrainer(
+        model, axes=axes, batch_size=args.batch_size, num_epoch=args.epochs,
+        worker_optimizer="adam", learning_rate=3e-3,
+        metrics_path=args.metrics,
+    )
+    trainer.train(ds)
+
+    first, last = trainer.history[0]["loss"], trainer.history[-1]["loss"]
+    toks = len(trainer.history) * args.batch_size * args.seq_len
+    rate = toks / trainer.get_training_time()
+    print(
+        f"mesh={axes} loss {first:.3f} -> {last:.3f} "
+        f"(uniform floor {np.log(args.vocab):.3f}) | "
+        f"{rate:,.0f} tokens/sec over {len(trainer.history)} steps"
+    )
+    assert last < first, "loss did not decrease"
+
+
+if __name__ == "__main__":
+    main()

@@ -68,3 +68,15 @@ def test_job_deployment_failure_raises():
               hosts=["local"], python=sys.executable)
     with pytest.raises(RuntimeError, match="failed"):
         job.run(wait=True)
+
+
+def test_lm_training_example_smoke(monkeypatch, capsys):
+    sys.path.insert(0, "examples")
+    run_example(
+        monkeypatch, "lm_training",
+        ["lm_training.py", "--dp", "4", "--sp", "2", "--n", "64",
+         "--seq-len", "64", "--d-model", "32", "--heads", "2",
+         "--batch-size", "16", "--epochs", "2", "--vocab", "64"],
+    )
+    out = capsys.readouterr().out
+    assert "tokens/sec" in out and "loss" in out
