@@ -6,3 +6,7 @@ from distkeras_tpu.data.spark_adapter import (  # noqa: F401
     dataset_from_spark_session,
     spark_available,
 )
+from distkeras_tpu.data.shard_io import (  # noqa: F401
+    ShardedDataset,
+    write_shards,
+)

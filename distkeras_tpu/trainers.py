@@ -791,25 +791,40 @@ class DataParallelTrainer(Trainer):
         super().__init__(*args, **kwargs)
         self.num_workers = num_workers
 
-    def _train(self, dataset: PartitionedDataset, shuffle: bool = False) -> Model:
-        if shuffle:
-            dataset = dataset.shuffle(seed=self.seed)
+    # global batches per stacked dispatch on the disk-streaming path: one
+    # XLA call covers this many batches, compiled once (+ one tail shape)
+    STREAM_GROUP = 16
+
+    def _train(self, dataset, shuffle: bool = False) -> Model:
+        from distkeras_tpu.data.shard_io import ShardedDataset
+
+        sharded = isinstance(dataset, ShardedDataset)
+        if sharded:
+            # disk-resident data plane: shards stream through the epoch
+            # loop via the native loader (never merged into one host
+            # array), reshuffled two-level per epoch when shuffle=True
+            probe = PartitionedDataset([dataset.read_shard(0)])
+            self.ensure_params(probe)
+        else:
+            if shuffle:
+                dataset = dataset.shuffle(seed=self.seed)
+            self.ensure_params(dataset)
         mesh = default_mesh(self.num_workers)
         n_dev = mesh.devices.size
-        self.ensure_params(dataset)
 
         optimizer = get_optimizer(self.worker_optimizer, self.learning_rate)
         loss_fn = get_loss(self.loss)
         metric_fns = resolve_metrics(self.metrics)
         apply_fn = self.model.apply
 
-        # Global batches: [n_batches, n_dev * batch_size, ...] — each device
-        # takes its batch_size-slice of every global batch.
-        merged = dataset.repartition(1).partition(0)
-        xb, yb = workers_mod.batch_partition(
-            merged, self.features_col, self.label_col,
-            self.batch_size * n_dev,
-        )
+        if not sharded:
+            # Global batches: [n_batches, n_dev * batch_size, ...] — each
+            # device takes its batch_size-slice of every global batch.
+            merged = dataset.repartition(1).partition(0)
+            xb, yb = workers_mod.batch_partition(
+                merged, self.features_col, self.label_col,
+                self.batch_size * n_dev,
+            )
 
         def device_step(carry, batch):
             params, opt_state = carry
@@ -866,11 +881,27 @@ class DataParallelTrainer(Trainer):
         # the dp axis and upload it ONCE before the epoch loop — zero
         # host->device traffic per epoch. Datasets over the staging budget
         # stream through in equal chunks instead (one upload per chunk per
-        # epoch, bounded residency).
+        # epoch, bounded residency). ShardedDatasets always stream from
+        # disk through the native loader.
         from jax.sharding import NamedSharding
 
         batch_sharding = NamedSharding(mesh, P(None, "dp"))
-        if xb.nbytes + yb.nbytes <= self.stage_limit_bytes:
+        staged = False
+        if sharded:
+            def epoch_chunks(epoch):
+                seed = self.seed + epoch if shuffle else None
+                bx, by = [], []
+                for b in dataset.batches(
+                    self.batch_size * n_dev, shuffle_seed=seed
+                ):
+                    bx.append(b[self.features_col])
+                    by.append(b[self.label_col])
+                    if len(bx) == self.STREAM_GROUP:
+                        yield np.stack(bx), np.stack(by)
+                        bx, by = [], []
+                if bx:
+                    yield np.stack(bx), np.stack(by)
+        elif xb.nbytes + yb.nbytes <= self.stage_limit_bytes:
             chunks = [(
                 jax.device_put(xb, batch_sharding),
                 jax.device_put(yb, batch_sharding),
@@ -883,12 +914,11 @@ class DataParallelTrainer(Trainer):
                 (xb[i:i + per_chunk], yb[i:i + per_chunk])
                 for i in range(0, len(xb), per_chunk)
             ]
-            staged = False
 
         history: History = []
         for epoch in range(start_epoch, self.num_epoch):
             epoch_rows: List[dict] = []
-            for cx, cy in chunks:
+            for cx, cy in (epoch_chunks(epoch) if sharded else chunks):
                 if not staged:
                     cx = jax.device_put(cx, batch_sharding)
                     cy = jax.device_put(cy, batch_sharding)

@@ -1,8 +1,10 @@
-"""Build the native transport library: ``python native/build.py``.
+"""Build the native libraries: ``python native/build.py``.
 
-Produces ``native/libdk_transport.so``; :mod:`distkeras_tpu.networking`
-auto-builds on first use if a compiler is available and falls back to the
-pure-Python framing otherwise.
+Produces ``native/libdk_transport.so`` (framed-socket data plane used by
+:mod:`distkeras_tpu.networking`) and ``native/libdk_dataio.so`` (shard IO
+kernels used by :mod:`distkeras_tpu.data.shard_io`). Both consumers
+auto-build on first use when a compiler is available and fall back to
+pure-Python implementations otherwise.
 """
 
 import os
@@ -11,21 +13,39 @@ import subprocess
 import sys
 
 HERE = os.path.dirname(os.path.abspath(__file__))
-SRC = os.path.join(HERE, "dk_transport.c")
-OUT = os.path.join(HERE, "libdk_transport.so")
+
+LIBS = {
+    "libdk_transport.so": "dk_transport.c",
+    "libdk_dataio.so": "dk_dataio.c",
+}
 
 
-def build(quiet: bool = False) -> str:
+def _cc():
     cc = os.environ.get("CC") or shutil.which("cc") or shutil.which("gcc") \
         or shutil.which("clang")
     if cc is None:
         raise RuntimeError("no C compiler found")
-    cmd = [cc, "-O2", "-shared", "-fPIC", "-o", OUT, SRC]
-    subprocess.run(cmd, check=True,
-                   capture_output=quiet)
-    return OUT
+    return cc
+
+
+def build_lib(lib_name: str, quiet: bool = False) -> str:
+    src = os.path.join(HERE, LIBS[lib_name])
+    out = os.path.join(HERE, lib_name)
+    cmd = [_cc(), "-O2", "-shared", "-fPIC", "-o", out, src]
+    subprocess.run(cmd, check=True, capture_output=quiet)
+    return out
+
+
+def build(quiet: bool = False) -> str:
+    """Back-compat entry: builds the transport lib, returns its path."""
+    return build_lib("libdk_transport.so", quiet=quiet)
+
+
+def build_all(quiet: bool = False):
+    return [build_lib(name, quiet=quiet) for name in LIBS]
 
 
 if __name__ == "__main__":
-    print(build())
+    for path in build_all():
+        print(path)
     sys.exit(0)

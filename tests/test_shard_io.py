@@ -1,0 +1,199 @@
+"""Shard IO: the on-disk data plane with native (C) loading kernels."""
+
+import numpy as np
+import pytest
+
+from distkeras_tpu.data.dataset import PartitionedDataset
+from distkeras_tpu.data.shard_io import (
+    ShardedDataset,
+    native_dataio_active,
+    write_shards,
+)
+
+
+def make_ds(n=200, dim=6, parts=4, seed=0):
+    rng = np.random.default_rng(seed)
+    return PartitionedDataset.from_arrays(
+        {
+            "features": rng.normal(size=(n, dim)).astype(np.float32),
+            "label": rng.integers(0, 10, size=n).astype(np.int64),
+        },
+        num_partitions=parts,
+    )
+
+
+def test_native_lib_builds():
+    assert native_dataio_active(), "C toolchain exists in the image; the " \
+        "dataio library should build"
+
+
+def test_write_read_roundtrip(tmp_path):
+    ds = make_ds()
+    d = write_shards(ds, str(tmp_path / "shards"))
+    sd = ShardedDataset(d)
+    assert sd.num_shards == 4
+    assert sd.num_rows == 200
+    loaded = sd.load()
+    np.testing.assert_array_equal(
+        loaded.column("features"), ds.column("features")
+    )
+    np.testing.assert_array_equal(loaded.column("label"), ds.column("label"))
+
+
+def test_resharding_on_write(tmp_path):
+    ds = make_ds(n=100, parts=1)
+    d = write_shards(ds, str(tmp_path / "s"), rows_per_shard=30)
+    sd = ShardedDataset(d)
+    assert sd.num_shards == 4  # 30+30+30+10
+    np.testing.assert_array_equal(
+        sd.load().column("features"), ds.column("features")
+    )
+
+
+def test_batches_cover_all_rows_without_shuffle(tmp_path):
+    ds = make_ds(n=128, parts=4)
+    sd = ShardedDataset(write_shards(ds, str(tmp_path / "s")))
+    got = list(sd.batches(batch_size=16))
+    assert len(got) == 8
+    feats = np.concatenate([b["features"] for b in got])
+    np.testing.assert_array_equal(feats, ds.column("features"))
+
+
+def test_batches_shuffled_cover_all_rows(tmp_path):
+    ds = make_ds(n=128, parts=4)
+    sd = ShardedDataset(write_shards(ds, str(tmp_path / "s")))
+    got = list(sd.batches(batch_size=16, shuffle_seed=1))
+    labels = np.sort(np.concatenate([b["label"] for b in got]))
+    np.testing.assert_array_equal(labels, np.sort(ds.column("label")))
+    # actually shuffled
+    first = np.concatenate([b["features"] for b in got])
+    assert not np.array_equal(first, ds.column("features"))
+    # deterministic per seed
+    again = list(sd.batches(batch_size=16, shuffle_seed=1))
+    np.testing.assert_array_equal(
+        first, np.concatenate([b["features"] for b in again])
+    )
+
+
+def test_ragged_shards_carry_leftover(tmp_path):
+    """Shard sizes not divisible by batch_size: leftovers roll into the
+    next shard; only the final sub-batch tail is dropped."""
+    ds = make_ds(n=130, parts=4)  # shards of 33/32/33/32
+    sd = ShardedDataset(write_shards(ds, str(tmp_path / "s")))
+    got = list(sd.batches(batch_size=16))
+    assert sum(len(b["label"]) for b in got) == 128  # 130 - tail of 2
+
+
+def test_fused_bf16_cast_matches_jnp(tmp_path):
+    import jax.numpy as jnp
+    import ml_dtypes
+
+    ds = make_ds(n=64, parts=2, seed=3)
+    sd = ShardedDataset(write_shards(ds, str(tmp_path / "s")))
+    got = list(sd.batches(batch_size=32, cast_bf16=["features"]))
+    assert got[0]["features"].dtype == ml_dtypes.bfloat16
+    ref = jnp.asarray(ds.column("features")).astype(jnp.bfloat16)
+    np.testing.assert_array_equal(
+        np.concatenate([b["features"] for b in got]).view(np.uint16),
+        np.asarray(ref).view(np.uint16),
+    )
+    # labels stay untouched
+    assert got[0]["label"].dtype == np.int64
+
+
+def test_bf16_cast_edge_values():
+    """RNE rounding incl. ties, NaN quieting, infinities — bit-exact vs
+    the jnp/ml_dtypes cast."""
+    import ctypes
+
+    import jax.numpy as jnp
+    import ml_dtypes
+
+    from distkeras_tpu.data import shard_io
+
+    lib = shard_io._load_native()
+    if lib is None:
+        pytest.skip("native lib unavailable")
+    vals = np.array([
+        0.0, -0.0, 1.0, -1.0, np.inf, -np.inf, np.nan,
+        3.14159265, -2.718281828, 1e-38, -1e38, 65504.0,
+        1.0039062,  # exactly between two bf16 values (tie -> even)
+        1.0117188, 0.10000000149011612, 123456.789,
+    ], dtype=np.float32)
+    out = np.empty(vals.shape, ml_dtypes.bfloat16)
+    idx = np.arange(len(vals), dtype=np.int64)
+    lib.dk_gather_cast_f32_bf16(
+        vals.ctypes.data_as(ctypes.c_void_p), 1,
+        idx.ctypes.data_as(ctypes.c_void_p), len(vals),
+        out.ctypes.data_as(ctypes.c_void_p),
+    )
+    ref = np.asarray(jnp.asarray(vals).astype(jnp.bfloat16))
+    np.testing.assert_array_equal(out.view(np.uint16), ref.view(np.uint16))
+
+
+def test_streamed_training_end_to_end(tmp_path):
+    """A sharded dataset streams through DataParallelTrainer-style manual
+    training: batches feed a jitted step, loss decreases."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from distkeras_tpu.models import get_model
+    from distkeras_tpu.utils.losses import get_loss
+    from distkeras_tpu.workers import make_train_step
+
+    rng = np.random.default_rng(0)
+    centers = rng.normal(size=(4, 8)) * 3
+    labels = rng.integers(0, 4, size=512)
+    feats = (centers[labels] + rng.normal(size=(512, 8))).astype(np.float32)
+    ds = PartitionedDataset.from_arrays(
+        {"features": feats, "label": labels.astype(np.int64)},
+        num_partitions=4,
+    )
+    sd = ShardedDataset(write_shards(ds, str(tmp_path / "s")))
+
+    model = get_model("mlp", features=(16,), num_classes=4,
+                      dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8)))
+    optimizer = optax.sgd(0.1)
+    opt_state = optimizer.init(params)
+    step = make_train_step(
+        model.apply, get_loss("sparse_categorical_crossentropy"), optimizer
+    )
+    losses = []
+    for epoch in range(3):
+        for batch in sd.batches(batch_size=64, shuffle_seed=epoch):
+            params, opt_state, m = step(
+                params, opt_state,
+                jnp.asarray(batch["features"]), jnp.asarray(batch["label"]),
+            )
+            losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+def test_data_parallel_trainer_streams_sharded_dataset(tmp_path):
+    """DataParallelTrainer consumes a ShardedDataset directly — the
+    disk-streaming path — and matches the learnable-task bar."""
+    import jax.numpy as jnp
+
+    from distkeras_tpu.trainers import DataParallelTrainer
+    from distkeras_tpu.models import get_model
+
+    rng = np.random.default_rng(1)
+    centers = rng.normal(size=(4, 8)) * 3
+    labels = rng.integers(0, 4, size=2048)
+    feats = (centers[labels] + rng.normal(size=(2048, 8))).astype(np.float32)
+    onehot = np.eye(4, dtype=np.float32)[labels]
+    ds = PartitionedDataset.from_arrays(
+        {"features": feats, "label": onehot}, num_partitions=8
+    )
+    sd = ShardedDataset(write_shards(ds, str(tmp_path / "s")))
+    trainer = DataParallelTrainer(
+        get_model("mlp", features=(16,), num_classes=4, dtype=jnp.float32),
+        num_workers=8, batch_size=16, num_epoch=3, learning_rate=0.05,
+        loss="categorical_crossentropy",
+    )
+    model = trainer.train(sd, shuffle=True)
+    assert trainer.history[-1]["loss"] < trainer.history[0]["loss"]
+    acc = (model.predict(feats).argmax(-1) == labels).mean()
+    assert acc > 0.9, acc
