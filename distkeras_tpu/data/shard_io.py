@@ -74,8 +74,29 @@ def _load_native():
         ctypes.c_void_p, ctypes.c_uint64, ctypes.c_void_p, ctypes.c_int64,
         ctypes.c_void_p,
     ]
+    lib.dk_cast_f32_bf16.restype = None
+    lib.dk_cast_f32_bf16.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_void_p,
+    ]
     _native = lib
     return lib
+
+
+def cast_f32_bf16(x: np.ndarray) -> np.ndarray:
+    """Contiguous float32 → bfloat16 via the native RNE kernel (bit-exact
+    with XLA's cast); numpy/ml_dtypes fallback without the library."""
+    import ml_dtypes
+
+    lib = _load_native()
+    if lib is None or x.size == 0:
+        return x.astype(ml_dtypes.bfloat16)
+    x = np.ascontiguousarray(x, np.float32)
+    out = np.empty(x.shape, ml_dtypes.bfloat16)
+    lib.dk_cast_f32_bf16(
+        x.ctypes.data_as(ctypes.c_void_p), x.size,
+        out.ctypes.data_as(ctypes.c_void_p),
+    )
+    return out
 
 
 def native_dataio_active() -> bool:
@@ -182,7 +203,11 @@ class ShardedDataset:
         lib = _load_native()
         rows = len(idx)
         row_shape = arr.shape[1:]
-        if lib is None:
+        row_elems = int(np.prod(row_shape)) if row_shape else 1
+        if lib is None or row_elems == 0:
+            # numpy path; also zero-width rows (nothing for C to copy —
+            # passing row_bytes=0 to memcpy loops is pointless and an
+            # `or 1` default would read out of bounds)
             out = arr[idx]
             if cast_bf16 and arr.dtype == np.float32:
                 import ml_dtypes
@@ -195,8 +220,7 @@ class ShardedDataset:
 
             out = np.empty((rows,) + row_shape, ml_dtypes.bfloat16)
             lib.dk_gather_cast_f32_bf16(
-                arr.ctypes.data_as(ctypes.c_void_p),
-                int(np.prod(row_shape) or 1),
+                arr.ctypes.data_as(ctypes.c_void_p), row_elems,
                 idx.ctypes.data_as(ctypes.c_void_p), rows,
                 out.ctypes.data_as(ctypes.c_void_p),
             )
@@ -204,7 +228,7 @@ class ShardedDataset:
         out = np.empty((rows,) + row_shape, arr.dtype)
         lib.dk_gather_rows(
             arr.ctypes.data_as(ctypes.c_void_p),
-            int(np.prod(row_shape) or 1) * arr.dtype.itemsize,
+            row_elems * arr.dtype.itemsize,
             idx.ctypes.data_as(ctypes.c_void_p), rows,
             out.ctypes.data_as(ctypes.c_void_p),
         )
@@ -239,6 +263,17 @@ class ShardedDataset:
         stop = threading.Event()
         error: List[BaseException] = []
 
+        def put(item) -> bool:
+            """Bounded put that aborts when the consumer is gone — the
+            producer must never block forever on an abandoned generator."""
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
         def producer():
             try:
                 leftover: Optional[Dict[str, np.ndarray]] = None
@@ -258,22 +293,28 @@ class ShardedDataset:
                         rng.shuffle(idx)
                     n_full = rows // batch_size
                     for b in range(n_full):
-                        if stop.is_set():
-                            return
                         bidx = idx[b * batch_size:(b + 1) * batch_size]
-                        q.put({
+                        if not put({
                             c: self._gather(shard[c], bidx, c in cast_cols)
                             for c in self.columns
-                        })
+                        }):
+                            return
                     tail = idx[n_full * batch_size:]
                     if len(tail):
                         leftover = {c: shard[c][tail] for c in self.columns}
                 if leftover is not None and not drop_remainder:
-                    q.put(leftover)
+                    # the remainder goes through the same assembly path as
+                    # every other batch (casts applied, dtypes consistent)
+                    n = len(next(iter(leftover.values())))
+                    ridx = np.arange(n)
+                    put({
+                        c: self._gather(leftover[c], ridx, c in cast_cols)
+                        for c in self.columns
+                    })
             except BaseException as e:  # surfaced to the consumer
                 error.append(e)
             finally:
-                q.put(_END)
+                put(_END)
 
         t = threading.Thread(target=producer, daemon=True)
         t.start()
@@ -285,11 +326,11 @@ class ShardedDataset:
                 yield item
         finally:
             stop.set()
-            # drain so a blocked producer can reach _END and exit
+            # unblock a producer waiting on a full queue; its timed put
+            # then observes stop and exits — no _END required after stop
             while True:
                 try:
-                    if q.get_nowait() is _END:
-                        break
+                    q.get_nowait()
                 except queue.Empty:
                     break
             t.join(timeout=10)

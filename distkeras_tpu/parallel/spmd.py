@@ -156,6 +156,13 @@ def make_lm_train_step(model, optimizer, mesh: Mesh,
     Returns ``step(params, opt_state, tokens) -> (params, opt_state, loss)``
     where loss is the global mean next-token cross-entropy.
     """
+    if sp_axis not in mesh.axis_names:
+        raise ValueError(
+            f"mesh {mesh.axis_names} has no '{sp_axis}' axis — the LM step "
+            "always shards the sequence over sp_axis; use a size-1 axis "
+            "for the unsharded-sequence case (e.g. make_mesh({'dp': n, "
+            "'sp': 1}))"
+        )
     sp_size = int(np.prod([s for a, s in zip(mesh.axis_names, mesh.devices.shape)
                            if a == sp_axis] or [1]))
     if tp_axis is None:

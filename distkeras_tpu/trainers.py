@@ -803,8 +803,10 @@ class DataParallelTrainer(Trainer):
             # disk-resident data plane: shards stream through the epoch
             # loop via the native loader (never merged into one host
             # array), reshuffled two-level per epoch when shuffle=True
-            probe = PartitionedDataset([dataset.read_shard(0)])
-            self.ensure_params(probe)
+            if self.params is None:
+                self.ensure_params(
+                    PartitionedDataset([dataset.read_shard(0)])
+                )
         else:
             if shuffle:
                 dataset = dataset.shuffle(seed=self.seed)
@@ -1001,6 +1003,12 @@ class LMTrainer(Trainer):
         if shuffle:
             dataset = dataset.shuffle(seed=self.seed)
         axes = dict(self.axes) if self.axes else {"dp": len(jax.devices())}
+        # the LM step always addresses the sp axis (ppermute targets,
+        # axis_index for global positions); a size-1 axis makes the
+        # single-chip case the same program as the sharded one
+        axes.setdefault("sp", 1)
+        if axes.get("tp", 1) == 1:
+            axes.pop("tp", None)
         mesh = make_mesh(axes)
         sp = axes.get("sp", 1)
         tp = axes.get("tp", 1)

@@ -42,5 +42,11 @@ def narrow_cast(x: np.ndarray, target_dtype) -> np.ndarray:
         return x
     td = np.dtype(target_dtype)
     if np.issubdtype(x.dtype, np.floating) and td.itemsize < x.dtype.itemsize:
+        if x.dtype == np.float32 and td.name == "bfloat16":
+            # hot path (multi-MB feature tensors every window): the native
+            # RNE kernel, bit-exact with XLA's cast
+            from distkeras_tpu.data.shard_io import cast_f32_bf16
+
+            return cast_f32_bf16(x)
         return x.astype(td)
     return x

@@ -197,3 +197,61 @@ def test_data_parallel_trainer_streams_sharded_dataset(tmp_path):
     assert trainer.history[-1]["loss"] < trainer.history[0]["loss"]
     acc = (model.predict(feats).argmax(-1) == labels).mean()
     assert acc > 0.9, acc
+
+
+def test_abandoned_stream_does_not_hang(tmp_path):
+    """Breaking out of batches() early (prefetch=1) must release the
+    producer thread promptly — no 10s join stall, no leaked thread."""
+    import threading
+    import time
+
+    ds = make_ds(n=512, parts=8)
+    sd = ShardedDataset(write_shards(ds, str(tmp_path / "s")))
+    before = threading.active_count()
+    t0 = time.monotonic()
+    gen = sd.batches(batch_size=16, prefetch=1)
+    next(gen)
+    gen.close()  # abandon mid-stream
+    dt = time.monotonic() - t0
+    assert dt < 5.0, f"early close took {dt:.1f}s (producer hung)"
+    deadline = time.monotonic() + 5
+    while threading.active_count() > before and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert threading.active_count() <= before, "producer thread leaked"
+
+
+def test_remainder_batch_gets_casts_too(tmp_path):
+    import ml_dtypes
+
+    ds = make_ds(n=100, parts=2)  # 100 % 32 != 0
+    sd = ShardedDataset(write_shards(ds, str(tmp_path / "s")))
+    got = list(sd.batches(batch_size=32, cast_bf16=["features"],
+                          drop_remainder=False))
+    assert sum(len(b["label"]) for b in got) == 100
+    assert all(b["features"].dtype == ml_dtypes.bfloat16 for b in got)
+
+
+def test_plain_cast_kernel_matches_jnp():
+    import jax.numpy as jnp
+
+    from distkeras_tpu.data.shard_io import cast_f32_bf16
+
+    x = np.random.default_rng(0).normal(size=(1000,)).astype(np.float32)
+    ref = np.asarray(jnp.asarray(x).astype(jnp.bfloat16))
+    np.testing.assert_array_equal(
+        cast_f32_bf16(x).view(np.uint16), ref.view(np.uint16)
+    )
+
+
+def test_zero_width_rows_safe(tmp_path):
+    ds = PartitionedDataset.from_arrays(
+        {"features": np.zeros((16, 0), np.float32),
+         "label": np.arange(16)},
+        num_partitions=2,
+    )
+    sd = ShardedDataset(write_shards(ds, str(tmp_path / "s")))
+    got = list(sd.batches(batch_size=8, cast_bf16=["features"]))
+    assert got[0]["features"].shape == (8, 0)
+    np.testing.assert_array_equal(
+        np.concatenate([b["label"] for b in got]), np.arange(16)
+    )
