@@ -336,3 +336,49 @@ class ShardedDataset:
             t.join(timeout=10)
         if error:
             raise error[0]
+
+
+class ShardRowSource:
+    """grain ``RandomAccessDataSource`` view of a shard directory.
+
+    SURVEY.md §7 notes grain is the environment's input library; this
+    adapter lets a shard directory feed grain's samplers/DataLoaders
+    (``grain.MapDataset.source(ShardRowSource(dir))``) without loading
+    everything: rows resolve through a one-shard LRU so sequential and
+    shard-local access patterns hit memory, and cold reads go through the
+    native loader.
+    """
+
+    def __init__(self, directory_or_dataset, cache_shards: int = 2):
+        self._sd = (directory_or_dataset
+                    if isinstance(directory_or_dataset, ShardedDataset)
+                    else ShardedDataset(directory_or_dataset))
+        self._starts = np.cumsum([0] + self._sd.shard_rows)
+        self._cache: "Dict[int, Dict[str, np.ndarray]]" = {}
+        self._cache_order: List[int] = []
+        self._cache_shards = max(1, cache_shards)
+        self._lock = threading.Lock()
+
+    def __len__(self) -> int:
+        return self._sd.num_rows
+
+    def _shard_for(self, index: int) -> Tuple[int, int]:
+        si = int(np.searchsorted(self._starts, index, side="right")) - 1
+        return si, index - int(self._starts[si])
+
+    def __getitem__(self, index: int) -> Dict[str, np.ndarray]:
+        if index < 0:
+            index += len(self)
+        if not 0 <= index < len(self):
+            raise IndexError(index)
+        si, offset = self._shard_for(index)
+        with self._lock:
+            shard = self._cache.get(si)
+        if shard is None:
+            shard = self._sd.read_shard(si)
+            with self._lock:
+                self._cache[si] = shard
+                self._cache_order.append(si)
+                while len(self._cache_order) > self._cache_shards:
+                    self._cache.pop(self._cache_order.pop(0), None)
+        return {c: shard[c][offset] for c in self._sd.columns}

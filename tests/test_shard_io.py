@@ -255,3 +255,30 @@ def test_zero_width_rows_safe(tmp_path):
     np.testing.assert_array_equal(
         np.concatenate([b["label"] for b in got]), np.arange(16)
     )
+
+
+def test_grain_source_adapter(tmp_path):
+    """ShardRowSource satisfies grain's RandomAccessDataSource protocol
+    and feeds a real grain MapDataset pipeline."""
+    grain = pytest.importorskip("grain")
+
+    from distkeras_tpu.data.shard_io import ShardRowSource
+
+    ds = make_ds(n=100, parts=4, seed=5)
+    d = write_shards(ds, str(tmp_path / "s"))
+    src = ShardRowSource(d)
+    assert len(src) == 100
+    np.testing.assert_array_equal(
+        src[37]["features"], ds.column("features")[37]
+    )
+    np.testing.assert_array_equal(src[-1]["label"], ds.column("label")[-1])
+
+    mapped = (
+        grain.MapDataset.source(src)
+        .shuffle(seed=0)
+        .batch(batch_size=20)
+    )
+    batches = list(mapped)
+    assert len(batches) == 5
+    labels = np.sort(np.concatenate([b["label"] for b in batches]))
+    np.testing.assert_array_equal(labels, np.sort(ds.column("label")))
