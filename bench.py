@@ -65,7 +65,7 @@ def main():
     from distkeras_tpu.utils.losses import get_loss
     from distkeras_tpu.workers import make_window_step
 
-    batch = 1024
+    batch = 2048  # measured knee of the batch-scaling curve on v5e
     steps_per_call = 10
     calls = 5
 

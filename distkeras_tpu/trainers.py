@@ -1067,11 +1067,27 @@ class LMTrainer(Trainer):
         token_sharding = NamedSharding(
             mesh, P("dp", "sp") if sp > 1 else P("dp")
         )
+        # stage every batch once when the corpus fits the budget — zero
+        # re-upload across epochs (same policy as DataParallelTrainer)
+        staged_batches = None
+        if batches.nbytes <= self.stage_limit_bytes:
+            staged_batches = [
+                jax.device_put(batches[b], token_sharding)
+                for b in range(len(batches))
+            ]
         history: History = []
         for epoch in range(start_epoch, self.num_epoch):
+            # keep losses on-device until the epoch ends: a per-step
+            # float(loss) would sync the dispatch pipeline every step
+            # (ruinous over high-latency transports); deferring keeps N
+            # steps in flight
+            epoch_losses = []
             for b in range(len(batches)):
-                xb = jax.device_put(batches[b], token_sharding)
+                xb = (staged_batches[b] if staged_batches is not None
+                      else jax.device_put(batches[b], token_sharding))
                 params, opt_state, loss = step(params, opt_state, xb)
+                epoch_losses.append(loss)
+            for loss in epoch_losses:
                 row = {"loss": float(loss)}
                 history.append(row)
                 if self.metrics_writer is not None:
