@@ -131,9 +131,20 @@ class Trainer:
         and closes the metrics file; subclasses implement :meth:`_train`."""
         try:
             self.record_training_start()
-            return self._train(dataset, shuffle)
+            return self._train(self._coerce_dataset(dataset), shuffle)
         finally:
             self.record_training_end()
+
+    def _coerce_dataset(self, dataset):
+        """Accept a ShardedDataset anywhere a PartitionedDataset works.
+        Trainers with a true streaming path (DataParallelTrainer, the
+        async PS family) override this to pass it through; the rest
+        materialize."""
+        from distkeras_tpu.data.shard_io import ShardedDataset
+
+        if isinstance(dataset, ShardedDataset):
+            return dataset.load()
+        return dataset
 
     def get_training_time(self) -> float:
         if self._t_start is None:
@@ -436,15 +447,60 @@ class DistributedTrainer(Trainer):
     def parallelism_factor(self) -> int:
         return 1
 
-    def _train(self, dataset: PartitionedDataset, shuffle: bool = False) -> Model:
+    def _coerce_dataset(self, dataset):
+        return dataset  # streaming path below handles ShardedDataset
+
+    def _train(self, dataset, shuffle: bool = False) -> Model:
         from distkeras_tpu import runtime
+        from distkeras_tpu.data.shard_io import ShardedDataset
 
         self.worker_restarts = 0  # per-run counter (trainers are reusable)
-        if shuffle:
-            dataset = dataset.shuffle(seed=self.seed)
         n_parts = self.num_workers * self.parallelism_factor
-        dataset = dataset.repartition(n_parts)
-        self.ensure_params(dataset)
+        sharded = isinstance(dataset, ShardedDataset)
+        if sharded:
+            # disk-resident path: each worker reads its own shard subset
+            # inside its thread (native pread, GIL released — reads run in
+            # parallel), two-level shuffle (shard assignment + in-worker
+            # rows); a restarted worker re-reads from disk, so memory stays
+            # bounded at one worker partition per live worker
+            if dataset.num_shards < n_parts:
+                raise ValueError(
+                    f"{dataset.num_shards} shards cannot feed {n_parts} "
+                    "workers — re-write with more shards (write_shards "
+                    "rows_per_shard=...)"
+                )
+            shard_order = np.arange(dataset.num_shards)
+            if shuffle:
+                shard_order = np.random.default_rng(self.seed).permutation(
+                    dataset.num_shards
+                )
+
+            def get_partition(i):
+                shards = [
+                    dataset.read_shard(int(s))
+                    for s in shard_order[i::n_parts]
+                ]
+                part = {
+                    c: np.concatenate([s[c] for s in shards])
+                    for c in dataset.columns
+                }
+                if shuffle:
+                    perm = np.random.default_rng(
+                        self.seed + 1 + i
+                    ).permutation(len(next(iter(part.values()))))
+                    part = {c: v[perm] for c, v in part.items()}
+                return part
+
+            if self.params is None:
+                self.ensure_params(
+                    PartitionedDataset([dataset.read_shard(0)])
+                )
+        else:
+            if shuffle:
+                dataset = dataset.shuffle(seed=self.seed)
+            dataset = dataset.repartition(n_parts)
+            self.ensure_params(dataset)
+            get_partition = dataset.partition
 
         # Topology: single-process (own the center in-process), explicit
         # remote_ps client, or auto-wired multi-host via the runtime
@@ -562,7 +618,7 @@ class DistributedTrainer(Trainer):
                 while True:
                     try:
                         _, history = workers[i].train(
-                            gi, dataset.partition(i), ps
+                            gi, get_partition(i), ps
                         )
                         results[i] = history
                         return
@@ -790,6 +846,9 @@ class DataParallelTrainer(Trainer):
     def __init__(self, *args, num_workers: Optional[int] = None, **kwargs):
         super().__init__(*args, **kwargs)
         self.num_workers = num_workers
+
+    def _coerce_dataset(self, dataset):
+        return dataset  # _train streams ShardedDatasets natively
 
     # global batches per stacked dispatch on the disk-streaming path: one
     # XLA call covers this many batches, compiled once (+ one tail shape)

@@ -282,3 +282,73 @@ def test_grain_source_adapter(tmp_path):
     assert len(batches) == 5
     labels = np.sort(np.concatenate([b["label"] for b in batches]))
     np.testing.assert_array_equal(labels, np.sort(ds.column("label")))
+
+
+def test_async_trainer_streams_sharded_dataset(tmp_path):
+    """DOWNPOUR consumes a ShardedDataset: each worker reads its shard
+    subset in its own thread."""
+    import jax.numpy as jnp
+
+    from distkeras_tpu.trainers import DOWNPOUR
+    from distkeras_tpu.models import get_model
+
+    rng = np.random.default_rng(2)
+    centers = rng.normal(size=(4, 8)) * 3
+    labels = rng.integers(0, 4, size=2048)
+    feats = (centers[labels] + rng.normal(size=(2048, 8))).astype(np.float32)
+    onehot = np.eye(4, dtype=np.float32)[labels]
+    ds = PartitionedDataset.from_arrays(
+        {"features": feats, "label": onehot}, num_partitions=8
+    )
+    sd = ShardedDataset(write_shards(ds, str(tmp_path / "s")))
+    trainer = DOWNPOUR(
+        get_model("mlp", features=(16,), num_classes=4, dtype=jnp.float32),
+        num_workers=4, communication_window=4, batch_size=32, num_epoch=3,
+        learning_rate=0.05, loss="categorical_crossentropy",
+    )
+    model = trainer.train(sd, shuffle=True)
+    assert len(trainer.executor_histories) == 4
+    acc = (model.predict(feats).argmax(-1) == labels).mean()
+    assert acc > 0.9, acc
+
+
+def test_async_trainer_too_few_shards_raises(tmp_path):
+    import jax.numpy as jnp
+    from distkeras_tpu.trainers import DOWNPOUR
+    from distkeras_tpu.models import get_model
+
+    ds = make_ds(n=64, parts=2)
+    sd = ShardedDataset(write_shards(ds, str(tmp_path / "s")))
+    trainer = DOWNPOUR(
+        get_model("mlp", features=(8,), num_classes=4, dtype=jnp.float32),
+        num_workers=4, batch_size=8, num_epoch=1,
+        loss="sparse_categorical_crossentropy",
+    )
+    with pytest.raises(ValueError, match="shards cannot feed"):
+        trainer.train(sd)
+
+
+def test_single_trainer_materializes_sharded_dataset(tmp_path):
+    """Trainers without a streaming path transparently load() shards."""
+    import jax.numpy as jnp
+
+    from distkeras_tpu.trainers import SingleTrainer
+    from distkeras_tpu.models import get_model
+
+    rng = np.random.default_rng(3)
+    centers = rng.normal(size=(4, 8)) * 3
+    labels = rng.integers(0, 4, size=512)
+    feats = (centers[labels] + rng.normal(size=(512, 8))).astype(np.float32)
+    ds = PartitionedDataset.from_arrays(
+        {"features": feats, "label": labels.astype(np.int64)},
+        num_partitions=4,
+    )
+    sd = ShardedDataset(write_shards(ds, str(tmp_path / "s")))
+    trainer = SingleTrainer(
+        get_model("mlp", features=(16,), num_classes=4, dtype=jnp.float32),
+        batch_size=32, num_epoch=5, learning_rate=0.1,
+        loss="sparse_categorical_crossentropy",
+    )
+    model = trainer.train(sd)
+    acc = (model.predict(feats).argmax(-1) == labels).mean()
+    assert acc > 0.9, acc
