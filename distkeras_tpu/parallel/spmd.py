@@ -133,7 +133,8 @@ def opt_state_specs(optimizer, params, param_specs):
 def make_lm_train_step(model, optimizer, mesh: Mesh,
                        dp_axis: str = "dp", sp_axis: str = "sp",
                        tp_axis: Optional[str] = None,
-                       params_template=None):
+                       params_template=None,
+                       window: bool = False):
     """Jitted language-model training step sharded over data x sequence
     (x tensor, optionally).
 
@@ -154,7 +155,10 @@ def make_lm_train_step(model, optimizer, mesh: Mesh,
     ``ppermute``; the final global position is masked out.
 
     Returns ``step(params, opt_state, tokens) -> (params, opt_state, loss)``
-    where loss is the global mean next-token cross-entropy.
+    where loss is the global mean next-token cross-entropy. With
+    ``window=True`` the step takes ``[W, B, T]`` stacked batches and runs
+    all W optimizer steps in one dispatch (``lax.scan``), returning the
+    ``[W]`` per-step losses.
     """
     if sp_axis not in mesh.axis_names:
         raise ValueError(
@@ -181,7 +185,7 @@ def make_lm_train_step(model, optimizer, mesh: Mesh,
         pspec = lm_param_specs(params_template, tp_axis=tp_axis)
         ospec = opt_state_specs(optimizer, params_template, pspec)
 
-    def device_step(params, opt_state, tokens):
+    def batch_update(params, opt_state, tokens):
         B_l, T_l = tokens.shape
         my_sp = jax.lax.axis_index(sp_axis)
         # neighbor's first column supervises my last position
@@ -213,11 +217,35 @@ def make_lm_train_step(model, optimizer, mesh: Mesh,
         loss = jax.lax.psum(local_obj, (dp_axis, sp_axis))
         return params, opt_state, loss
 
+    if not window:
+        return jax.jit(
+            shard_map(
+                batch_update,
+                mesh=mesh,
+                in_specs=(pspec, ospec, P(dp_axis, sp_axis)),
+                out_specs=(pspec, ospec, P()),
+            )
+        )
+
+    def device_window(params, opt_state, tokens):
+        # tokens [W, B_l, T_l]: scan the per-batch update so W optimizer
+        # steps are ONE device dispatch (the host round-trip per step is
+        # the bottleneck on remote transports, and non-trivial anywhere)
+        def body(carry, tok):
+            p, s = carry
+            p, s, loss = batch_update(p, s, tok)
+            return (p, s), loss
+
+        (params, opt_state), losses = jax.lax.scan(
+            body, (params, opt_state), tokens
+        )
+        return params, opt_state, losses
+
     return jax.jit(
         shard_map(
-            device_step,
+            device_window,
             mesh=mesh,
-            in_specs=(pspec, ospec, P(dp_axis, sp_axis)),
+            in_specs=(pspec, ospec, P(None, dp_axis, sp_axis)),
             out_specs=(pspec, ospec, P()),
         )
     )

@@ -1099,6 +1099,7 @@ class LMTrainer(Trainer):
             self.model, optimizer, mesh,
             tp_axis="tp" if tp > 1 else None,
             params_template=self.params if tp > 1 else None,
+            window=True,
         )
 
         B = self.batch_size
@@ -1123,36 +1124,40 @@ class LMTrainer(Trainer):
                 opt_state = state["opt_state"] or opt_state
                 start_epoch = int(state["extra"].get("epoch", ck_step))
 
-        token_sharding = NamedSharding(
-            mesh, P("dp", "sp") if sp > 1 else P("dp")
+        window_sharding = NamedSharding(
+            mesh, P(None, "dp", "sp") if sp > 1 else P(None, "dp")
         )
-        # stage every batch once when the corpus fits the budget — zero
-        # re-upload across epochs (same policy as DataParallelTrainer)
-        staged_batches = None
+        # stage the whole epoch tensor once when it fits the budget — zero
+        # re-upload across epochs; else stream window groups per epoch
+        W = 16
         if batches.nbytes <= self.stage_limit_bytes:
-            staged_batches = [
-                jax.device_put(batches[b], token_sharding)
-                for b in range(len(batches))
+            epoch_windows = [jax.device_put(batches, window_sharding)]
+            staged = True
+        else:
+            epoch_windows = [
+                batches[i:i + W] for i in range(0, len(batches), W)
             ]
+            staged = False
         history: History = []
         for epoch in range(start_epoch, self.num_epoch):
-            # keep losses on-device until the epoch ends: a per-step
-            # float(loss) would sync the dispatch pipeline every step
-            # (ruinous over high-latency transports); deferring keeps N
-            # steps in flight
+            # the whole epoch (or each window group) is ONE device
+            # dispatch: the windowed step scans the optimizer updates
+            # on-device, so no per-step host round-trip exists at all
             epoch_losses = []
-            for b in range(len(batches)):
-                xb = (staged_batches[b] if staged_batches is not None
-                      else jax.device_put(batches[b], token_sharding))
-                params, opt_state, loss = step(params, opt_state, xb)
-                epoch_losses.append(loss)
-            for loss in epoch_losses:
-                row = {"loss": float(loss)}
-                history.append(row)
-                if self.metrics_writer is not None:
-                    self.metrics_writer.log(
-                        step=len(history), samples=B * tokens.shape[1], **row
-                    )
+            for wb in epoch_windows:
+                if not staged:
+                    wb = jax.device_put(wb, window_sharding)
+                params, opt_state, losses = step(params, opt_state, wb)
+                epoch_losses.append(losses)
+            for losses in epoch_losses:
+                for loss in np.asarray(losses):
+                    row = {"loss": float(loss)}
+                    history.append(row)
+                    if self.metrics_writer is not None:
+                        self.metrics_writer.log(
+                            step=len(history), samples=B * tokens.shape[1],
+                            **row,
+                        )
             if self.checkpointer is not None:
                 self.checkpointer.maybe_save(
                     epoch + 1, jax.tree.map(np.asarray, params),

@@ -147,3 +147,33 @@ def test_lm_step_tp_params_match_unsharded_step():
             np.asarray(leaf), np.asarray(ref), rtol=2e-4, atol=2e-5,
             err_msg=jax.tree_util.keystr(key),
         )
+
+
+def test_lm_window_step_matches_sequential_steps():
+    """window=True runs W optimizer steps in one dispatch and must equal W
+    sequential single-batch steps exactly."""
+    mesh = make_mesh({"dp": 4, "sp": 2})
+    ring = get_model("transformer_lm", attention="ring", seq_axis="sp", **LM_KW)
+    std = get_model("transformer_lm", attention="standard", **LM_KW)
+    W = 4
+    tokens = jnp.asarray(
+        np.random.default_rng(7).integers(0, 64, size=(W, 8, 32)), jnp.int32
+    )
+    params = std.init(jax.random.PRNGKey(0), tokens[0, :, :16])
+    optimizer = optax.adam(1e-2)
+
+    wstep = make_lm_train_step(ring, optimizer, mesh, window=True)
+    pw, sw, losses = wstep(params, optimizer.init(params), tokens)
+    assert losses.shape == (W,)
+
+    step = make_lm_train_step(ring, optimizer, mesh)
+    p, s = params, optimizer.init(params)
+    seq_losses = []
+    for i in range(W):
+        p, s, loss = step(p, s, tokens[i])
+        seq_losses.append(float(loss))
+    np.testing.assert_allclose(np.asarray(losses), seq_losses, rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(pw), jax.tree.leaves(p)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6
+        )
