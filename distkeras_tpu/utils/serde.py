@@ -42,20 +42,64 @@ def deserialize_pytree(data: bytes, like: Optional[Any] = None) -> Any:
     return flax_serialization.msgpack_restore(data)
 
 
+def _encode_kwarg(v):
+    """Make a model-constructor kwarg msgpack-safe: dtype objects (jnp
+    scalar types, np.dtype) become a tagged name; containers recurse
+    (msgpack itself turns tuples into lists — decode restores them)."""
+    if isinstance(v, (type, np.dtype)):
+        try:
+            return {"__dtype__": np.dtype(v).name}
+        except TypeError:
+            pass
+    if isinstance(v, (list, tuple)):
+        return [_encode_kwarg(x) for x in v]
+    if isinstance(v, dict):
+        return {k: _encode_kwarg(x) for k, x in v.items()}
+    return v
+
+
+def _decode_kwarg(v):
+    """Inverse of :func:`_encode_kwarg`. Lists become tuples: every
+    sequence kwarg in the model zoo is a tuple (flax modules must stay
+    hashable for the compile-sharing caches), and msgpack erased the
+    distinction anyway."""
+    if isinstance(v, dict):
+        if set(v.keys()) == {"__dtype__"}:
+            return np.dtype(v["__dtype__"])
+        return {k: _decode_kwarg(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return tuple(_decode_kwarg(x) for x in v)
+    return v
+
+
 def serialize_model(module_spec: dict, params: Any) -> dict:
     """``(module spec, params)`` → transportable dict.
 
     ``module_spec`` is ``{'name': registered_model_name, 'kwargs': {...}}``
     (see :func:`distkeras_tpu.models.get_model`), mirroring the reference's
-    ``{'model': to_json(), 'weights': get_weights()}`` layout.
+    ``{'model': to_json(), 'weights': get_weights()}`` layout. Kwargs are
+    encoded msgpack-safe so the blob survives the wire
+    (:mod:`distkeras_tpu.networking`) and disk, not just in-process
+    hand-off.
     """
-    return {"model": dict(module_spec), "weights": serialize_pytree(params)}
+    spec = {
+        "name": module_spec["name"],
+        "kwargs": {
+            k: _encode_kwarg(v)
+            for k, v in module_spec.get("kwargs", {}).items()
+        },
+    }
+    return {"model": spec, "weights": serialize_pytree(params)}
 
 
 def deserialize_model(blob: dict):
     """Inverse of :func:`serialize_model` → ``(module, params)``."""
     from distkeras_tpu.models import get_model
 
-    module = get_model(blob["model"]["name"], **blob["model"].get("kwargs", {}))
+    kwargs = {
+        k: _decode_kwarg(v)
+        for k, v in blob["model"].get("kwargs", {}).items()
+    }
+    module = get_model(blob["model"]["name"], **kwargs)
     params = deserialize_pytree(blob["weights"])
     return module, params
