@@ -878,13 +878,32 @@ class DataParallelTrainer(Trainer):
         metric_fns = resolve_metrics(self.metrics)
         apply_fn = self.model.apply
 
+        # Multi-process SPMD (pod-style): when jax.distributed is up, the
+        # mesh spans every process's devices; each process feeds ITS
+        # devices' slice of every global batch and
+        # make_array_from_process_local_data assembles the global array —
+        # the sync-over-ICI/DCN analogue of the reference's per-executor
+        # partitions (runtime.py brings the processes up).
+        multiproc = jax.process_count() > 1
+        feed_dev = (
+            len([d for d in mesh.devices.flat
+                 if d.process_index == jax.process_index()])
+            if multiproc else n_dev
+        )
+        if multiproc and feed_dev == 0:
+            raise ValueError(
+                "this process owns no devices in the mesh — check "
+                "num_workers vs the per-process device count"
+            )
+
         if not sharded:
             # Global batches: [n_batches, n_dev * batch_size, ...] — each
-            # device takes its batch_size-slice of every global batch.
+            # device takes its batch_size-slice of every global batch
+            # (per process, its local feed_dev share).
             merged = dataset.repartition(1).partition(0)
             xb, yb = workers_mod.batch_partition(
                 merged, self.features_col, self.label_col,
-                self.batch_size * n_dev,
+                self.batch_size * feed_dev,
             )
 
         def device_step(carry, batch):
@@ -947,13 +966,21 @@ class DataParallelTrainer(Trainer):
         from jax.sharding import NamedSharding
 
         batch_sharding = NamedSharding(mesh, P(None, "dp"))
+
+        def put_batches(arr):
+            if multiproc:
+                return jax.make_array_from_process_local_data(
+                    batch_sharding, arr
+                )
+            return jax.device_put(arr, batch_sharding)
+
         staged = False
         if sharded:
             def epoch_chunks(epoch):
                 seed = self.seed + epoch if shuffle else None
                 bx, by = [], []
                 for b in dataset.batches(
-                    self.batch_size * n_dev, shuffle_seed=seed
+                    self.batch_size * feed_dev, shuffle_seed=seed
                 ):
                     bx.append(b[self.features_col])
                     by.append(b[self.label_col])
@@ -963,10 +990,7 @@ class DataParallelTrainer(Trainer):
                 if bx:
                     yield np.stack(bx), np.stack(by)
         elif xb.nbytes + yb.nbytes <= self.stage_limit_bytes:
-            chunks = [(
-                jax.device_put(xb, batch_sharding),
-                jax.device_put(yb, batch_sharding),
-            )]
+            chunks = [(put_batches(xb), put_batches(yb))]
             staged = True
         else:
             bytes_per_batch = max(1, (xb.nbytes + yb.nbytes) // len(xb))
@@ -981,8 +1005,8 @@ class DataParallelTrainer(Trainer):
             epoch_rows: List[dict] = []
             for cx, cy in (epoch_chunks(epoch) if sharded else chunks):
                 if not staged:
-                    cx = jax.device_put(cx, batch_sharding)
-                    cy = jax.device_put(cy, batch_sharding)
+                    cx = put_batches(cx)
+                    cy = put_batches(cy)
                 params, opt_state, ms = sharded_epoch(params, opt_state, cx, cy)
                 ms = {k: np.asarray(v) for k, v in ms.items()}
                 epoch_rows.extend(

@@ -91,3 +91,94 @@ def test_job_two_process_loopback_training(tmp_path):
     np.testing.assert_allclose(p0, p1, rtol=1e-6)
     # commits arrived from both processes (4 workers x >=2 rounds)
     assert int((tmp_path / "updates.txt").read_text()) >= 8
+
+
+SPMD_SCRIPT = textwrap.dedent("""
+    import os, sys
+    sys.path.insert(0, {repo!r})
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=4"
+    )
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from distkeras_tpu import runtime
+    from distkeras_tpu.data.dataset import PartitionedDataset
+    from distkeras_tpu.models import get_model
+    from distkeras_tpu.trainers import DataParallelTrainer
+
+    ctx = runtime.initialize()
+    assert jax.process_count() == 2
+    assert len(jax.devices()) == 8  # global mesh spans both processes
+
+    rng = np.random.default_rng(0)
+    n, d, c = 1024, 8, 4
+    centers = rng.normal(size=(c, d)) * 3
+    lab = rng.integers(0, c, size=n)
+    X = (centers[lab] + rng.normal(size=(n, d))).astype(np.float32)
+    Y = np.eye(c, dtype=np.float32)[lab]
+    # each process feeds its devices' share of every global batch
+    half = slice(0, n // 2) if ctx.process_id == 0 else slice(n // 2, n)
+    ds = PartitionedDataset.from_arrays(
+        {{"features": X[half], "label": Y[half]}}, num_partitions=1
+    )
+
+    t = DataParallelTrainer(
+        get_model("mlp", features=(16,), num_classes=4),
+        batch_size=16, num_epoch=3, learning_rate=0.05,
+        loss="categorical_crossentropy",
+    )
+    m = t.train(ds)
+    assert t.history[-1]["loss"] < t.history[0]["loss"]
+    acc = (np.asarray(m.predict(X)).argmax(-1) == lab).mean()
+    flat = np.concatenate(
+        [np.asarray(x).ravel() for x in jax.tree.leaves(m.params)]
+    )
+    out = os.environ["DK_TEST_OUT"]
+    np.save(os.path.join(out, f"spmd_params_{{ctx.process_id}}.npy"), flat)
+    with open(os.path.join(out, f"spmd_acc_{{ctx.process_id}}.txt"), "w") as fh:
+        fh.write(str(float(acc)))
+    runtime.shutdown()
+""")
+
+
+def test_two_process_spmd_data_parallel(tmp_path):
+    """True pod-style SPMD: one DataParallelTrainer program over a global
+    8-device mesh spanning TWO processes (4 virtual CPU devices each),
+    inputs assembled from process-local data."""
+    import subprocess
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = tmp_path / "spmd_train.py"
+    script.write_text(SPMD_SCRIPT.format(repo=repo))
+    coord = f"127.0.0.1:{_free_port()}"
+    ps = f"127.0.0.1:{_free_port()}"
+
+    procs = []
+    for pid in range(2):
+        env = dict(os.environ)
+        env.update({
+            "DK_TPU_COORDINATOR": coord,
+            "DK_TPU_PROCESS_ID": str(pid),
+            "DK_TPU_NUM_PROCESSES": "2",
+            "DK_TPU_PS_ADDRESS": ps,
+            "DK_TEST_OUT": str(tmp_path),
+            "JAX_PLATFORMS": "cpu",
+        })
+        env.pop("JAX_PLATFORM_NAME", None)
+        procs.append(subprocess.Popen(
+            [sys.executable, str(script)], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        ))
+    outs = [p.communicate(timeout=420) for p in procs]
+    for p, (so, se) in zip(procs, outs):
+        assert p.returncode == 0, f"rc={p.returncode}\n{se[-3000:]}"
+
+    p0 = np.load(tmp_path / "spmd_params_0.npy")
+    p1 = np.load(tmp_path / "spmd_params_1.npy")
+    np.testing.assert_allclose(p0, p1, rtol=1e-6, atol=1e-7)  # replicated
+    for pid in range(2):
+        acc = float((tmp_path / f"spmd_acc_{pid}.txt").read_text())
+        assert acc > 0.9, acc
