@@ -1049,6 +1049,12 @@ class LMTrainer(Trainer):
     batch sharded batch-over-dp, sequence-over-sp. The loss is the global
     mean next-token cross-entropy (``loss``/``metrics``/``label_col``
     kwargs are ignored — an LM supervises itself).
+
+    Multi-process (pod) runs: with ``jax.distributed`` up (see
+    :mod:`distkeras_tpu.runtime`) the mesh spans all processes; each
+    process supplies its own token rows and ``batch_size`` counts THIS
+    process's contribution per step (global batch = batch_size x
+    num_processes).
     """
 
     def __init__(self, model, *args, axes: Optional[dict] = None,
@@ -1151,11 +1157,21 @@ class LMTrainer(Trainer):
         window_sharding = NamedSharding(
             mesh, P(None, "dp", "sp") if sp > 1 else P(None, "dp")
         )
+
+        # multi-process pod runs: this process feeds its devices' share of
+        # every global token batch (same contract as DataParallelTrainer)
+        def put_windows(arr):
+            if jax.process_count() > 1:
+                return jax.make_array_from_process_local_data(
+                    window_sharding, arr
+                )
+            return jax.device_put(arr, window_sharding)
+
         # stage the whole epoch tensor once when it fits the budget — zero
         # re-upload across epochs; else stream window groups per epoch
         W = 16
         if batches.nbytes <= self.stage_limit_bytes:
-            epoch_windows = [jax.device_put(batches, window_sharding)]
+            epoch_windows = [put_windows(batches)]
             staged = True
         else:
             epoch_windows = [
@@ -1170,7 +1186,7 @@ class LMTrainer(Trainer):
             epoch_losses = []
             for wb in epoch_windows:
                 if not staged:
-                    wb = jax.device_put(wb, window_sharding)
+                    wb = put_windows(wb)
                 params, opt_state, losses = step(params, opt_state, wb)
                 epoch_losses.append(losses)
             for losses in epoch_losses:

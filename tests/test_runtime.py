@@ -182,3 +182,80 @@ def test_two_process_spmd_data_parallel(tmp_path):
     for pid in range(2):
         acc = float((tmp_path / f"spmd_acc_{pid}.txt").read_text())
         assert acc > 0.9, acc
+
+
+LM_SPMD_SCRIPT = textwrap.dedent("""
+    import os, sys
+    sys.path.insert(0, {repo!r})
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=4"
+    )
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from distkeras_tpu import runtime
+    from distkeras_tpu.data.dataset import PartitionedDataset
+    from distkeras_tpu.models import get_model
+    from distkeras_tpu.trainers import LMTrainer
+
+    ctx = runtime.initialize()
+    assert len(jax.devices()) == 8
+
+    T = 32
+    tokens = np.random.default_rng(ctx.process_id).integers(
+        0, 64, size=(32, T)
+    ).astype(np.int32)
+    ds = PartitionedDataset.from_arrays({{"tokens": tokens}}, 1)
+    model = get_model(
+        "transformer_lm", vocab_size=64, d_model=32, num_heads=2,
+        num_layers=2, max_len=T, dtype=np.float32,
+        attention="ring", seq_axis="sp",
+    )
+    t = LMTrainer(model, axes={{"dp": 4, "sp": 2}}, batch_size=8,
+                  num_epoch=3, worker_optimizer="adam", learning_rate=1e-2)
+    m = t.train(ds)
+    assert t.history[-1]["loss"] < t.history[0]["loss"]
+    flat = np.concatenate(
+        [np.asarray(x).ravel() for x in jax.tree.leaves(m.params)]
+    )
+    np.save(os.path.join(os.environ["DK_TEST_OUT"],
+                         f"lm_params_{{ctx.process_id}}.npy"), flat)
+    runtime.shutdown()
+""")
+
+
+def test_two_process_spmd_lm_trainer(tmp_path):
+    """LMTrainer over a global dp=4 x sp=2 mesh spanning two processes:
+    ring attention + cross-shard targets + windowed epoch dispatch, with
+    each process feeding its own token rows."""
+    import subprocess
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = tmp_path / "lm_spmd.py"
+    script.write_text(LM_SPMD_SCRIPT.format(repo=repo))
+    coord = f"127.0.0.1:{_free_port()}"
+    ps = f"127.0.0.1:{_free_port()}"
+
+    procs = []
+    for pid in range(2):
+        env = dict(os.environ)
+        env.update({
+            "DK_TPU_COORDINATOR": coord,
+            "DK_TPU_PROCESS_ID": str(pid),
+            "DK_TPU_NUM_PROCESSES": "2",
+            "DK_TPU_PS_ADDRESS": ps,
+            "DK_TEST_OUT": str(tmp_path),
+            "JAX_PLATFORMS": "cpu",
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, str(script)], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        ))
+    outs = [p.communicate(timeout=420) for p in procs]
+    for p, (so, se) in zip(procs, outs):
+        assert p.returncode == 0, f"rc={p.returncode}\n{se[-3000:]}"
+    p0 = np.load(tmp_path / "lm_params_0.npy")
+    p1 = np.load(tmp_path / "lm_params_1.npy")
+    np.testing.assert_allclose(p0, p1, rtol=1e-5, atol=1e-6)
