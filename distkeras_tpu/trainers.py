@@ -1076,6 +1076,8 @@ class LMTrainer(Trainer):
         spec = model_spec(self.model)
         kwargs = dict(spec["kwargs"])
         kwargs.update(attention="standard", tp_size=1)
+        if "ep_size" in kwargs:
+            kwargs["ep_size"] = 1  # full expert banks; mesh slices them
         twin = get_model(spec["name"], **kwargs)
         T_local = tokens.shape[1] // sp
         self.params = twin.init(
@@ -1092,25 +1094,42 @@ class LMTrainer(Trainer):
         if shuffle:
             dataset = dataset.shuffle(seed=self.seed)
         axes = dict(self.axes) if self.axes else {"dp": len(jax.devices())}
-        # the LM step always addresses the sp axis (ppermute targets,
-        # axis_index for global positions); a size-1 axis makes the
-        # single-chip case the same program as the sharded one
-        axes.setdefault("sp", 1)
-        if axes.get("tp", 1) == 1:
-            axes.pop("tp", None)
-        mesh = make_mesh(axes)
-        sp = axes.get("sp", 1)
-        tp = axes.get("tp", 1)
-        if sp > 1 and self.model.attention != "ring":
-            raise ValueError(
-                "sp > 1 needs the model built with attention='ring' "
-                "(seq_axis='sp')"
-            )
-        if getattr(self.model, "tp_size", 1) != tp:
-            raise ValueError(
-                f"model.tp_size={getattr(self.model, 'tp_size', 1)} != "
-                f"mesh tp size {tp}"
-            )
+        # an MoE model (ep_size > 1) trains on a (dp, ep) mesh via the
+        # MoE step; everything else on dp x sp (x tp) via the LM step
+        moe = getattr(self.model, "ep_size", 1) > 1
+        if moe:
+            if "ep" not in axes:
+                raise ValueError(
+                    "MoE model (ep_size > 1) needs an 'ep' mesh axis, "
+                    "e.g. axes={'dp': 2, 'ep': 4}"
+                )
+            for bad in ("sp", "tp"):
+                if axes.pop(bad, 1) > 1:
+                    raise ValueError(
+                        f"MoE training shards (dp, ep) only; drop {bad}"
+                    )
+            mesh = make_mesh(axes)
+            sp = tp = 1
+        else:
+            # the LM step always addresses the sp axis (ppermute targets,
+            # axis_index for global positions); a size-1 axis makes the
+            # single-chip case the same program as the sharded one
+            axes.setdefault("sp", 1)
+            if axes.get("tp", 1) == 1:
+                axes.pop("tp", None)
+            mesh = make_mesh(axes)
+            sp = axes.get("sp", 1)
+            tp = axes.get("tp", 1)
+            if sp > 1 and self.model.attention != "ring":
+                raise ValueError(
+                    "sp > 1 needs the model built with attention='ring' "
+                    "(seq_axis='sp')"
+                )
+            if getattr(self.model, "tp_size", 1) != tp:
+                raise ValueError(
+                    f"model.tp_size={getattr(self.model, 'tp_size', 1)} != "
+                    f"mesh tp size {tp}"
+                )
 
         tokens = np.asarray(dataset.column(self.tokens_col))
         if tokens.ndim != 2:
@@ -1125,12 +1144,19 @@ class LMTrainer(Trainer):
         self._init_params(tokens, sp)
 
         optimizer = get_optimizer(self.worker_optimizer, self.learning_rate)
-        step = make_lm_train_step(
-            self.model, optimizer, mesh,
-            tp_axis="tp" if tp > 1 else None,
-            params_template=self.params if tp > 1 else None,
-            window=True,
-        )
+        if moe:
+            from distkeras_tpu.parallel.spmd import make_moe_lm_train_step
+
+            step = make_moe_lm_train_step(
+                self.model, optimizer, mesh, params_template=self.params
+            )
+        else:
+            step = make_lm_train_step(
+                self.model, optimizer, mesh,
+                tp_axis="tp" if tp > 1 else None,
+                params_template=self.params if tp > 1 else None,
+                window=True,
+            )
 
         B = self.batch_size
         n = (len(tokens) // B) * B
@@ -1154,43 +1180,47 @@ class LMTrainer(Trainer):
                 opt_state = state["opt_state"] or opt_state
                 start_epoch = int(state["extra"].get("epoch", ck_step))
 
-        window_sharding = NamedSharding(
-            mesh, P(None, "dp", "sp") if sp > 1 else P(None, "dp")
-        )
+        if moe:
+            # MoE step consumes one [B, T] batch per call, sharded dp x ep
+            feed_sharding = NamedSharding(mesh, P(("dp", "ep")))
+            feed = [batches[b] for b in range(len(batches))]
+        else:
+            # windowed LM step: the whole epoch (or W-batch groups) is ONE
+            # device dispatch — the scan runs the optimizer steps on-device
+            feed_sharding = NamedSharding(
+                mesh, P(None, "dp", "sp") if sp > 1 else P(None, "dp")
+            )
+            W = 16
+            feed = ([batches] if batches.nbytes <= self.stage_limit_bytes
+                    else [batches[i:i + W]
+                          for i in range(0, len(batches), W)])
 
         # multi-process pod runs: this process feeds its devices' share of
         # every global token batch (same contract as DataParallelTrainer)
-        def put_windows(arr):
+        def put_feed(arr):
             if jax.process_count() > 1:
                 return jax.make_array_from_process_local_data(
-                    window_sharding, arr
+                    feed_sharding, arr
                 )
-            return jax.device_put(arr, window_sharding)
+            return jax.device_put(arr, feed_sharding)
 
-        # stage the whole epoch tensor once when it fits the budget — zero
-        # re-upload across epochs; else stream window groups per epoch
-        W = 16
-        if batches.nbytes <= self.stage_limit_bytes:
-            epoch_windows = [put_windows(batches)]
-            staged = True
-        else:
-            epoch_windows = [
-                batches[i:i + W] for i in range(0, len(batches), W)
-            ]
-            staged = False
+        # stage everything once when it fits the budget — zero re-upload
+        # across epochs
+        staged = batches.nbytes <= self.stage_limit_bytes
+        if staged:
+            feed = [put_feed(f) for f in feed]
         history: History = []
         for epoch in range(start_epoch, self.num_epoch):
-            # the whole epoch (or each window group) is ONE device
-            # dispatch: the windowed step scans the optimizer updates
-            # on-device, so no per-step host round-trip exists at all
+            # keep losses on-device until the epoch ends so dispatches
+            # pipeline (no per-step host sync)
             epoch_losses = []
-            for wb in epoch_windows:
+            for fb in feed:
                 if not staged:
-                    wb = put_windows(wb)
-                params, opt_state, losses = step(params, opt_state, wb)
+                    fb = put_feed(fb)
+                params, opt_state, losses = step(params, opt_state, fb)
                 epoch_losses.append(losses)
             for losses in epoch_losses:
-                for loss in np.asarray(losses):
+                for loss in np.atleast_1d(np.asarray(losses)):
                     row = {"loss": float(loss)}
                     history.append(row)
                     if self.metrics_writer is not None:

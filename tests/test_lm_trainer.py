@@ -118,3 +118,35 @@ def test_lm_trainer_validation_errors():
     with pytest.raises(ValueError, match="not divisible"):
         bad = token_dataset(T=31)
         LMTrainer(ring, axes={"dp": 4, "sp": 2}, batch_size=16).train(bad)
+
+
+def test_lm_trainer_moe_dp_ep():
+    """An MoE model routes LMTrainer onto the (dp, ep) MoE step."""
+    tokens = np.random.default_rng(4).integers(
+        0, 64, size=(64, 16)
+    ).astype(np.int32)
+    ds = PartitionedDataset.from_arrays({"tokens": tokens}, 4)
+    model = get_model(
+        "moe_lm", vocab_size=64, d_model=32, num_heads=2, num_layers=2,
+        max_len=16, dtype=jnp.float32, moe_experts=8, ep_size=4,
+        ep_axis="ep",
+    )
+    t = LMTrainer(model, axes={"dp": 2, "ep": 4}, batch_size=16,
+                  num_epoch=6, worker_optimizer="adam", learning_rate=3e-3)
+    trained = t.train(ds)
+    assert trained is not None
+    assert len(t.history) == 6 * 4
+    assert t.history[-1]["loss"] < t.history[0]["loss"]
+
+
+def test_lm_trainer_moe_requires_ep_axis():
+    tokens = np.random.default_rng(5).integers(
+        0, 64, size=(32, 16)
+    ).astype(np.int32)
+    ds = PartitionedDataset.from_arrays({"tokens": tokens}, 1)
+    model = get_model(
+        "moe_lm", vocab_size=64, d_model=32, num_heads=2, num_layers=1,
+        max_len=16, dtype=jnp.float32, moe_experts=4, ep_size=4,
+    )
+    with pytest.raises(ValueError, match="'ep' mesh axis"):
+        LMTrainer(model, axes={"dp": 8}, batch_size=16).train(ds)
