@@ -80,3 +80,14 @@ def test_lm_training_example_smoke(monkeypatch, capsys):
     )
     out = capsys.readouterr().out
     assert "tokens/sec" in out and "loss" in out
+
+
+def test_bigdata_pipeline_example_smoke(monkeypatch, capsys):
+    sys.path.insert(0, "examples")
+    run_example(
+        monkeypatch, "bigdata_pipeline",
+        ["bigdata_pipeline.py", "--n", "2048", "--rows-per-shard", "512",
+         "--batch-size", "32", "--epochs", "2"],
+    )
+    out = capsys.readouterr().out
+    assert "accuracy over 2048 rows" in out
