@@ -42,7 +42,24 @@ def main():
     from distkeras_tpu.predictors import ModelPredictor
     from distkeras_tpu.trainers import DataParallelTrainer
 
+    cleanup = args.dir is None  # auto temp dirs are removed on exit
     workdir = args.dir or tempfile.mkdtemp(prefix="dk_bigdata_")
+    try:
+        run_pipeline(args, workdir, native_dataio_active)
+    finally:
+        if cleanup:
+            import shutil
+
+            shutil.rmtree(workdir, ignore_errors=True)
+
+
+def run_pipeline(args, workdir, native_dataio_active):
+    from distkeras_tpu import PartitionedDataset
+    from distkeras_tpu.data import ShardedDataset, write_shards
+    from distkeras_tpu.models import get_model
+    from distkeras_tpu.predictors import ModelPredictor
+    from distkeras_tpu.trainers import DataParallelTrainer
+
     rng = np.random.default_rng(0)
     centers = rng.normal(size=(10, 32)) * 3.0
     labels = rng.integers(0, 10, size=args.n)
