@@ -31,7 +31,7 @@ the native losses; leave it False for bit-faithful inference parity.
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import flax.linen as nn
 import jax
@@ -70,9 +70,15 @@ class KerasImported(nn.Module):
     pairs (hashability keeps flax module equality/compile-sharing intact).
     Parameterized layers are named ``layer_{i}`` by their position, which
     is the contract :func:`build_params` fills weights against.
+
+    ``precision``: None uses the device default (on TPU, bfloat16-pass
+    float32 matmuls — fast, ~1e-3 divergence from CPU Keras);
+    ``"highest"`` forces full-precision MXU passes for bit-closer parity
+    with the original Keras outputs.
     """
 
     layers: Tuple[Tuple[str, Tuple], ...] = ()
+    precision: Optional[str] = None
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -83,7 +89,7 @@ class KerasImported(nn.Module):
             if kind == "dense":
                 x = nn.Dense(
                     cfg["units"], use_bias=cfg.get("use_bias", True),
-                    name=name,
+                    precision=self.precision, name=name,
                 )(x)
                 x = _act(cfg.get("activation"))(x)
             elif kind == "conv2d":
@@ -93,7 +99,7 @@ class KerasImported(nn.Module):
                     strides=tuple(cfg.get("strides", (1, 1))),
                     padding=cfg.get("padding", "valid").upper(),
                     use_bias=cfg.get("use_bias", True),
-                    name=name,
+                    precision=self.precision, name=name,
                 )(x)
                 x = _act(cfg.get("activation"))(x)
             elif kind == "flatten":
@@ -219,6 +225,7 @@ def from_keras_config(
     config: Dict[str, Any],
     weights: Sequence[np.ndarray],
     strip_final_softmax: bool = False,
+    precision: Optional[str] = None,
 ):
     """(Sequential config dict, weight list) → framework ``Model``.
 
@@ -229,16 +236,18 @@ def from_keras_config(
     from distkeras_tpu.models.wrapper import Model
 
     spec = keras_config_to_spec(config, strip_final_softmax)
-    module = KerasImported(layers=spec)
+    module = KerasImported(layers=spec, precision=precision)
     return Model(module, build_params(spec, weights))
 
 
-def from_keras(keras_model, strip_final_softmax: bool = False):
+def from_keras(keras_model, strip_final_softmax: bool = False,
+               precision: Optional[str] = None):
     """Live Keras model → framework ``Model`` (requires keras importable)."""
     return from_keras_config(
         keras_model.get_config(),
         keras_model.get_weights(),
         strip_final_softmax=strip_final_softmax,
+        precision=precision,
     )
 
 

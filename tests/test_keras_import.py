@@ -135,3 +135,12 @@ def test_unsupported_layers_raise_with_names():
 
 def test_keras_available_flag():
     assert keras_available()
+
+
+def test_precision_knob_accepted():
+    km = seq_mlp()
+    model = from_keras(km, precision="highest")
+    x = np.random.default_rng(6).normal(size=(8, 16)).astype(np.float32)
+    np.testing.assert_allclose(
+        model.predict(x), km.predict(x, verbose=0), rtol=1e-4, atol=1e-5
+    )
