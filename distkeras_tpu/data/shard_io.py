@@ -382,3 +382,37 @@ class ShardRowSource:
                 while len(self._cache_order) > self._cache_shards:
                     self._cache.pop(self._cache_order.pop(0), None)
         return {c: shard[c][offset] for c in self._sd.columns}
+
+
+def map_shards(dataset: ShardedDataset, fn, out_directory: str) -> str:
+    """Apply ``fn(shard_dict) -> shard_dict`` shard by shard, writing the
+    results as a new shard directory — one shard resident at a time, so
+    pipeline stages (transformers, predictors) run at disk scale exactly
+    like the reference's ``mapPartitions`` stages ran on Spark partitions.
+    """
+    os.makedirs(out_directory, exist_ok=True)
+    meta: Dict = {"version": 1, "columns": None, "shards": []}
+    for i in range(dataset.num_shards):
+        out = fn(dataset.read_shard(i))
+        rows = {len(v) for v in out.values()}
+        if len(rows) != 1:
+            raise ValueError(
+                f"map_shards fn returned ragged columns for shard {i}: "
+                f"{ {k: len(v) for k, v in out.items()} }"
+            )
+        if meta["columns"] is None:
+            meta["columns"] = {
+                c: {
+                    "dtype": np.asarray(v).dtype.str,
+                    "row_shape": list(np.asarray(v).shape[1:]),
+                }
+                for c, v in out.items()
+            }
+        meta["shards"].append({"rows": rows.pop()})
+        for c, v in out.items():
+            np.ascontiguousarray(v).tofile(
+                os.path.join(out_directory, f"shard_{i:05d}.{c}.bin")
+            )
+    with open(os.path.join(out_directory, "meta.json"), "w") as fh:
+        json.dump(meta, fh)
+    return out_directory

@@ -25,7 +25,8 @@ class AccuracyEvaluator(Evaluator):
 
     ``prediction_col`` may hold class indices (from LabelIndexTransformer)
     or raw prediction vectors (argmax applied); ``label_col`` may be integer
-    or one-hot.
+    or one-hot. Accepts a :class:`ShardedDataset` too — evaluation then
+    streams shard by shard (exact count aggregation, one shard resident).
     """
 
     def __init__(self, prediction_col: str = "predicted_index",
@@ -33,14 +34,28 @@ class AccuracyEvaluator(Evaluator):
         self.prediction_col = prediction_col
         self.label_col = label_col
 
-    def evaluate(self, dataset: PartitionedDataset) -> float:
-        pred = dataset.column(self.prediction_col)
-        label = dataset.column(self.label_col)
+    def _score(self, pred: np.ndarray, label: np.ndarray) -> int:
         if pred.ndim > 1:
             pred = pred.argmax(-1)
         if label.ndim > 1:
             label = label.argmax(-1)
-        return float(np.mean(pred.astype(np.int64) == label.astype(np.int64)))
+        return int(np.sum(pred.astype(np.int64) == label.astype(np.int64)))
+
+    def evaluate(self, dataset) -> float:
+        from distkeras_tpu.data.shard_io import ShardedDataset
+
+        if isinstance(dataset, ShardedDataset):
+            correct = total = 0
+            for i in range(dataset.num_shards):
+                shard = dataset.read_shard(i)
+                correct += self._score(
+                    shard[self.prediction_col], shard[self.label_col]
+                )
+                total += len(shard[self.label_col])
+            return correct / total
+        pred = dataset.column(self.prediction_col)
+        label = dataset.column(self.label_col)
+        return self._score(pred, label) / len(label)
 
 
 class LossEvaluator(Evaluator):

@@ -113,43 +113,16 @@ class ModelPredictor(Predictor):
         ``output_col`` appended — one shard resident at a time, so the
         dataset never has to fit in host memory (the disk-scale analogue
         of the reference's mapPartitions predict)."""
-        from distkeras_tpu.data.dataset import PartitionedDataset as PD
-        from distkeras_tpu.data.shard_io import ShardedDataset, write_shards
-        import os
+        from distkeras_tpu.data.shard_io import ShardedDataset, map_shards
 
         if not isinstance(dataset, ShardedDataset):
             raise TypeError("predict_sharded takes a ShardedDataset")
-        os.makedirs(out_directory, exist_ok=True)
-        meta = None
-        for i in range(dataset.num_shards):
-            shard = dataset.read_shard(i)
-            shard[self.output_col] = self._predict_array(
+
+        def stage(shard):
+            out = dict(shard)
+            out[self.output_col] = self._predict_array(
                 shard[self.features_col]
             )
-            piece = write_shards(
-                PD([shard]), os.path.join(out_directory, f"_part_{i:05d}")
-            )
-            del piece
-        # merge the per-shard directories into one (cheap renames)
-        import json
-        import shutil
+            return out
 
-        merged = {"version": 1, "columns": None, "shards": []}
-        for i in range(dataset.num_shards):
-            d = os.path.join(out_directory, f"_part_{i:05d}")
-            with open(os.path.join(d, "meta.json")) as fh:
-                m = json.load(fh)
-            if merged["columns"] is None:
-                merged["columns"] = m["columns"]
-            merged["shards"].extend(m["shards"])
-            for f in os.listdir(d):
-                if f.endswith(".bin"):
-                    col = f.split(".", 1)[1][: -len(".bin")]
-                    os.replace(
-                        os.path.join(d, f),
-                        os.path.join(out_directory, f"shard_{i:05d}.{col}.bin"),
-                    )
-            shutil.rmtree(d)
-        with open(os.path.join(out_directory, "meta.json"), "w") as fh:
-            json.dump(merged, fh)
-        return out_directory
+        return map_shards(dataset, stage, out_directory)

@@ -29,6 +29,30 @@ class Transformer:
     def transform(self, dataset: PartitionedDataset) -> PartitionedDataset:
         raise NotImplementedError
 
+    def transform_sharded(self, dataset, out_directory: str) -> str:
+        """Disk-scale transform: apply this stage shard by shard over a
+        :class:`~distkeras_tpu.data.shard_io.ShardedDataset`, writing a new
+        shard directory (the reference's mapPartitions stage at HDFS scale,
+        one shard resident at a time).
+
+        Stages that FIT statistics from the data (``MinMaxTransformer``
+        without explicit ``o_min``/``o_max``) must be given their
+        statistics up front — per-shard fitting would silently use
+        different scales per shard, so that case raises.
+        """
+        from distkeras_tpu.data.shard_io import map_shards
+
+        self._check_sharded_safe()
+
+        def stage(shard):
+            return self.transform(PartitionedDataset([shard])).partition(0)
+
+        return map_shards(dataset, stage, out_directory)
+
+    def _check_sharded_safe(self):
+        """Override to reject per-shard application when the stage would
+        fit global statistics from the data."""
+
 
 class OneHotTransformer(Transformer):
     """Integer label column → one-hot float vector column.
@@ -67,6 +91,14 @@ class MinMaxTransformer(Transformer):
         self.n_min, self.n_max = n_min, n_max
         self.input_col = input_col
         self.output_col = output_col
+
+    def _check_sharded_safe(self):
+        if self.o_min is None or self.o_max is None:
+            raise ValueError(
+                "MinMaxTransformer without explicit o_min/o_max fits the "
+                "range from data; per-shard fitting would scale each shard "
+                "differently — pass o_min/o_max for transform_sharded"
+            )
 
     def transform(self, dataset: PartitionedDataset) -> PartitionedDataset:
         o_min = self.o_min

@@ -388,3 +388,50 @@ def test_predict_sharded_streams_and_matches(tmp_path):
         pred.predict(sd).column("prediction"), ref.column("prediction"),
         rtol=1e-5, atol=1e-6,
     )
+
+
+def test_transform_sharded_pipeline(tmp_path):
+    """Transformer stages run shard-by-shard via map_shards; fit-from-data
+    stages refuse per-shard application."""
+    from distkeras_tpu.transformers import MinMaxTransformer, OneHotTransformer
+
+    ds = make_ds(n=96, parts=3, seed=9)
+    sd = ShardedDataset(write_shards(ds, str(tmp_path / "in")))
+
+    out_dir = OneHotTransformer(10).transform_sharded(
+        sd, str(tmp_path / "onehot")
+    )
+    out = ShardedDataset(out_dir)
+    assert out.num_shards == 3
+    ref = OneHotTransformer(10).transform(ds)
+    np.testing.assert_array_equal(
+        out.load().column("label_encoded"), ref.column("label_encoded")
+    )
+
+    # explicit-range MinMax works shard-by-shard and equals the in-memory run
+    mm = MinMaxTransformer(o_min=-5.0, o_max=5.0)
+    mm_dir = mm.transform_sharded(sd, str(tmp_path / "mm"))
+    np.testing.assert_allclose(
+        ShardedDataset(mm_dir).load().column("features_normalized"),
+        mm.transform(ds).column("features_normalized"),
+    )
+
+    # fit-from-data MinMax must refuse (per-shard stats would diverge)
+    with pytest.raises(ValueError, match="o_min/o_max"):
+        MinMaxTransformer().transform_sharded(sd, str(tmp_path / "bad"))
+
+
+def test_accuracy_evaluator_streams_shards(tmp_path):
+    from distkeras_tpu.evaluators import AccuracyEvaluator
+
+    rng = np.random.default_rng(11)
+    label = rng.integers(0, 4, size=100)
+    pred = label.copy()
+    wrong = rng.choice(100, size=25, replace=False)
+    pred[wrong] = (pred[wrong] + 1) % 4
+    ds = PartitionedDataset.from_arrays(
+        {"predicted_index": pred, "label": label}, num_partitions=3
+    )
+    sd = ShardedDataset(write_shards(ds, str(tmp_path / "s")))
+    ev = AccuracyEvaluator()
+    assert ev.evaluate(sd) == ev.evaluate(ds) == 0.75
