@@ -100,6 +100,10 @@ class PartitionedDataset:
 
     def column(self, name: str) -> np.ndarray:
         """Materialize one column across all partitions (a ``collect``)."""
+        if name not in self._partitions[0]:
+            raise KeyError(
+                f"column '{name}' not in dataset; available: {self.columns}"
+            )
         return np.concatenate([p[name] for p in self._partitions], axis=0)
 
     # -- Spark-shaped operations -------------------------------------------

@@ -113,6 +113,12 @@ def batch_partition(
     ragged batch means an XLA recompile per partition, which costs far more
     than the <1 batch of data).
     """
+    missing = [c for c in (features_col, label_col) if c not in partition]
+    if missing:
+        raise KeyError(
+            f"column(s) {missing} not in partition; available: "
+            f"{sorted(partition)} — check features_col/label_col"
+        )
     x = partition[features_col]
     y = partition[label_col]
     n = (len(x) // batch_size) * batch_size
