@@ -253,7 +253,8 @@ def make_lm_train_step(model, optimizer, mesh: Mesh,
 
 def make_moe_lm_train_step(model, optimizer, mesh: Mesh,
                            dp_axis: str = "dp", ep_axis: str = "ep",
-                           params_template=None, aux_weight: float = 0.01):
+                           params_template=None, aux_weight: float = 0.01,
+                           window: bool = False):
     """Jitted MoE language-model step over a (dp, ep) mesh.
 
     ``tokens [B, T]`` is sharded over BOTH axes jointly (``P((dp, ep))``) —
@@ -268,6 +269,8 @@ def make_moe_lm_train_step(model, optimizer, mesh: Mesh,
     intermediates).
 
     Returns ``step(params, opt_state, tokens) -> (params, opt_state, loss)``.
+    With ``window=True`` the step takes ``[W, B, T]`` stacked batches and
+    runs all W optimizer steps in one dispatch, returning ``[W]`` losses.
     """
     if params_template is None:
         raise ValueError("MoE step needs params_template to infer specs")
@@ -311,11 +314,32 @@ def make_moe_lm_train_step(model, optimizer, mesh: Mesh,
         loss = jax.lax.pmean(local_ce, (dp_axis, ep_axis))
         return params, opt_state, loss
 
+    if not window:
+        return jax.jit(
+            shard_map(
+                device_step,
+                mesh=mesh,
+                in_specs=(pspec, ospec, P((dp_axis, ep_axis))),
+                out_specs=(pspec, ospec, P()),
+            )
+        )
+
+    def device_window(params, opt_state, tokens):  # [W, B_l, T]
+        def body(carry, tok):
+            p, st = carry
+            p, st, loss = device_step(p, st, tok)
+            return (p, st), loss
+
+        (params, opt_state), losses = jax.lax.scan(
+            body, (params, opt_state), tokens
+        )
+        return params, opt_state, losses
+
     return jax.jit(
         shard_map(
-            device_step,
+            device_window,
             mesh=mesh,
-            in_specs=(pspec, ospec, P((dp_axis, ep_axis))),
+            in_specs=(pspec, ospec, P(None, (dp_axis, ep_axis))),
             out_specs=(pspec, ospec, P()),
         )
     )

@@ -1148,7 +1148,8 @@ class LMTrainer(Trainer):
             from distkeras_tpu.parallel.spmd import make_moe_lm_train_step
 
             step = make_moe_lm_train_step(
-                self.model, optimizer, mesh, params_template=self.params
+                self.model, optimizer, mesh, params_template=self.params,
+                window=True,
             )
         else:
             step = make_lm_train_step(
@@ -1181,9 +1182,12 @@ class LMTrainer(Trainer):
                 start_epoch = int(state["extra"].get("epoch", ck_step))
 
         if moe:
-            # MoE step consumes one [B, T] batch per call, sharded dp x ep
-            feed_sharding = NamedSharding(mesh, P(("dp", "ep")))
-            feed = [batches[b] for b in range(len(batches))]
+            # windowed MoE step: [W, B, T] stacked batches, sharded dp x ep
+            feed_sharding = NamedSharding(mesh, P(None, ("dp", "ep")))
+            W = 16
+            feed = ([batches] if batches.nbytes <= self.stage_limit_bytes
+                    else [batches[i:i + W]
+                          for i in range(0, len(batches), W)])
         else:
             # windowed LM step: the whole epoch (or W-batch groups) is ONE
             # device dispatch — the scan runs the optimizer steps on-device
