@@ -18,9 +18,10 @@ params)`` pair:
 
 Supported layers (the reference's example vocabulary): Dense, Conv2D,
 Flatten, Reshape, MaxPooling2D, AveragePooling2D, Dropout (identity —
-framework losses regularize elsewhere), Activation/ReLU/Softmax,
-InputLayer. Anything else raises with the layer name so the user knows
-what to port by hand.
+framework losses regularize elsewhere), BatchNormalization (moving
+statistics folded into a frozen affine — exact at inference),
+Activation/ReLU/Softmax, InputLayer. Anything else raises with the layer
+name so the user knows what to port by hand.
 
 Training note: the reference's models end in ``softmax`` and train with
 Keras' probability-input crossentropy; this framework's losses fold the
@@ -60,6 +61,21 @@ def _act(name):
             f"Unsupported Keras activation '{name}'. "
             f"Known: {sorted(k for k in _ACTIVATIONS if k)}"
         ) from None
+
+
+class _FrozenAffine(nn.Module):
+    """Inference-mode BatchNormalization: moving statistics folded into a
+    per-channel scale/bias by :func:`build_params`."""
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param(
+            "scale", nn.initializers.ones, (x.shape[-1],), jnp.float32
+        )
+        bias = self.param(
+            "bias", nn.initializers.zeros, (x.shape[-1],), jnp.float32
+        )
+        return x * scale.astype(x.dtype) + bias.astype(x.dtype)
 
 
 @register_model("keras_imported")
@@ -118,6 +134,10 @@ class KerasImported(nn.Module):
                                 padding=cfg.get("padding", "valid").upper())
             elif kind == "activation":
                 x = _act(cfg.get("activation"))(x)
+            elif kind == "batchnorm":
+                # inference-mode BN folded to a frozen affine (exact for
+                # prediction; a frozen affine under further training)
+                x = _FrozenAffine(name=name)(x)
             elif kind == "dropout":
                 pass  # identity at inference; framework trains without it
             else:
@@ -136,6 +156,7 @@ _KERAS_KIND = {
     "ReLU": "activation",
     "Softmax": "activation",
     "Dropout": "dropout",
+    "BatchNormalization": "batchnorm",
 }
 
 _KEPT_KEYS = {
@@ -148,6 +169,7 @@ _KEPT_KEYS = {
     "activation": ("activation",),
     "flatten": (),
     "dropout": (),
+    "batchnorm": ("epsilon", "center", "scale"),
 }
 
 
@@ -206,9 +228,25 @@ def build_params(spec, weights: Sequence[np.ndarray]) -> Dict[str, Any]:
     weights = list(weights)
     params: Dict[str, Any] = {}
     for i, (kind, cfg_items) in enumerate(spec):
-        if kind not in ("dense", "conv2d"):
+        if kind not in ("dense", "conv2d", "batchnorm"):
             continue
         cfg = dict(cfg_items)
+        if kind == "batchnorm":
+            # keras order: [gamma?, beta?, moving_mean, moving_var]
+            gamma = (np.asarray(weights.pop(0), np.float64)
+                     if cfg.get("scale", True) else None)
+            beta = (np.asarray(weights.pop(0), np.float64)
+                    if cfg.get("center", True) else None)
+            mean = np.asarray(weights.pop(0), np.float64)
+            var = np.asarray(weights.pop(0), np.float64)
+            eps = float(cfg.get("epsilon", 1e-3))
+            scale = (gamma if gamma is not None else 1.0) / np.sqrt(var + eps)
+            bias = (beta if beta is not None else 0.0) - mean * scale
+            params[f"layer_{i}"] = {
+                "scale": jnp.asarray(scale, jnp.float32),
+                "bias": jnp.asarray(bias, jnp.float32),
+            }
+            continue
         entry = {"kernel": jnp.asarray(weights.pop(0), jnp.float32)}
         if cfg.get("use_bias", True):
             entry["bias"] = jnp.asarray(weights.pop(0), jnp.float32)

@@ -123,13 +123,32 @@ def test_serde_round_trip():
     )
 
 
-def test_unsupported_layers_raise_with_names():
+def test_batchnorm_folds_to_frozen_affine():
+    """BN moving statistics fold into scale/bias: inference-exact vs a
+    TRAINED keras model (non-trivial moving stats)."""
     km = keras.Sequential([
         keras.layers.Input((16,)),
-        keras.layers.Dense(8),
+        keras.layers.Dense(32, activation="relu"),
         keras.layers.BatchNormalization(),
+        keras.layers.Dense(4, activation="softmax"),
     ])
-    with pytest.raises(ValueError, match="BatchNormalization"):
+    rng = np.random.default_rng(7)
+    x = (rng.normal(size=(256, 16)) * 3 + 1).astype(np.float32)
+    y = rng.integers(0, 4, size=256)
+    km.compile(optimizer="adam", loss="sparse_categorical_crossentropy")
+    km.fit(x, y, epochs=2, batch_size=32, verbose=0)  # real moving stats
+    model = from_keras(km)
+    np.testing.assert_allclose(
+        model.predict(x), km.predict(x, verbose=0), rtol=1e-4, atol=1e-5
+    )
+
+
+def test_unsupported_layers_raise_with_names():
+    km = keras.Sequential([
+        keras.layers.Input((4, 16)),
+        keras.layers.LSTM(8),
+    ])
+    with pytest.raises(ValueError, match="LSTM"):
         from_keras(km)
 
 
