@@ -181,6 +181,7 @@ class Block(nn.Module):
     moe_experts: int = 0
     ep_size: int = 1
     ep_axis: str = "ep"
+    moe_top_k: int = 1  # 1 = Switch, 2 = GShard-style routing
 
     @nn.compact
     def __call__(self, x):
@@ -200,6 +201,7 @@ class Block(nn.Module):
                 ep_size=self.ep_size,
                 ep_axis=self.ep_axis,
                 dtype=self.dtype,
+                top_k=self.moe_top_k,
                 name="moe",
             )(h)
         else:
@@ -240,6 +242,7 @@ class TransformerLM(nn.Module):
     moe_experts: int = 0
     ep_size: int = 1
     ep_axis: str = "ep"
+    moe_top_k: int = 1
 
     @nn.compact
     def __call__(self, tokens, train: bool = False):
@@ -267,6 +270,7 @@ class TransformerLM(nn.Module):
                 moe_experts=self.moe_experts,
                 ep_size=self.ep_size,
                 ep_axis=self.ep_axis,
+                moe_top_k=self.moe_top_k,
                 name=f"Block_{i}",
             )(x)
         x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)

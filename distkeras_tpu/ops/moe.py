@@ -32,45 +32,56 @@ def switch_moe(
     ep_axis: Optional[str] = None,
     capacity_factor: float = 1.25,
     dtype=jnp.float32,
+    top_k: int = 1,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Top-1 switch layer. Returns ``(y [S, D], aux_loss scalar)``.
+    """Top-k routed MoE layer. Returns ``(y [S, D], aux_loss scalar)``.
+
+    ``top_k=1`` is the Switch Transformer; ``top_k=2`` is GShard-style
+    (gates of the chosen experts renormalized to sum to 1, first choices
+    get capacity priority over second choices).
 
     With ``ep_axis`` set (inside shard_map), each device holds
     ``E_local = E_global / ep_size`` experts and its own ``S`` tokens;
     dispatch crosses devices via two ``all_to_all``s. Capacity is
-    ``capacity_factor * S / E_global`` **per source device** — the same
-    number whether sharded or not, which keeps the sharded layer exactly
-    equal to per-source-block unsharded computation (tested).
+    ``capacity_factor * top_k * S / E_global`` **per source device** — the
+    same number whether sharded or not, which keeps the sharded layer
+    exactly equal to per-source-block unsharded computation (tested).
 
     The aux term is the Switch load-balancing loss
-    ``E * sum_e(fraction_dispatched_e * mean_router_prob_e)`` over the
+    ``E * sum_e(fraction_first_choice_e * mean_router_prob_e)`` over the
     LOCAL tokens (callers psum/mean it across shards).
     """
     S, D = x.shape
     E_local = w1.shape[0]
     E = E_local * ep_size
-    C = max(1, int(capacity_factor * S / E))
+    k = top_k
+    C = max(1, int(capacity_factor * k * S / E))
 
     logits = (x.astype(jnp.float32) @ router_kernel.astype(jnp.float32))
     probs = jax.nn.softmax(logits, axis=-1)               # [S, E] f32
-    expert = jnp.argmax(probs, axis=-1)                   # [S]
-    gate = jnp.max(probs, axis=-1)                        # [S]
+    gate_k, expert_k = jax.lax.top_k(probs, k)            # [S, k]
+    if k > 1:
+        gate_k = gate_k / gate_k.sum(-1, keepdims=True)
 
-    onehot = jax.nn.one_hot(expert, E, dtype=jnp.float32)  # [S, E]
-    # rank of each token within its expert's queue (1-based)
-    rank = jnp.cumsum(onehot, axis=0) * onehot
+    # choice-major flattening: ALL first choices rank (and claim capacity)
+    # before any second choice — the GShard priority rule
+    flat_expert = expert_k.T.reshape(k * S)               # [k*S]
+    onehot_flat = jax.nn.one_hot(flat_expert, E, dtype=jnp.float32)
+    rank = jnp.cumsum(onehot_flat, axis=0) * onehot_flat  # 1-based
     keep = (rank > 0) & (rank <= C)
-    dispatch = onehot * keep                              # [S, E]
-    pos = jnp.clip(rank - 1, 0, C - 1).astype(jnp.int32)  # [S, E]
-    # [S, E, C] one-hot over capacity slots for kept tokens
-    dispatch_t = dispatch[..., None] * jax.nn.one_hot(pos, C, dtype=jnp.float32)
+    dispatch = onehot_flat * keep                         # [k*S, E]
+    pos = jnp.clip(rank - 1, 0, C - 1).astype(jnp.int32)
+    dispatch_t = (
+        dispatch[..., None] * jax.nn.one_hot(pos, C, dtype=jnp.float32)
+    ).reshape(k, S, E, C)
+    send_t = dispatch_t.sum(axis=0)                       # [S, E, C]
+    combine_t = jnp.einsum("ksec,sk->sec", dispatch_t, gate_k)
 
-    # aux load-balancing loss (Switch eq. 4): fraction of tokens ROUTED to
-    # each expert (pre-capacity) x mean router prob, scaled by E
-    frac = onehot.mean(axis=0)
+    # aux load-balancing loss (Switch eq. 4) over FIRST choices
+    frac = onehot_flat.reshape(k, S, E)[0].mean(axis=0)
     aux = E * jnp.sum(frac * probs.mean(axis=0))
 
-    d = jnp.einsum("sd,sec->ecd", x.astype(jnp.float32), dispatch_t)  # [E, C, D]
+    d = jnp.einsum("sd,sec->ecd", x.astype(jnp.float32), send_t)  # [E, C, D]
     if ep_axis is not None and ep_size > 1:
         d = d.reshape(ep_size, E_local, C, D)
         # axis 0 = destination device → after exchange, axis 0 = source
@@ -84,7 +95,6 @@ def switch_moe(
         y = y.reshape(E_local, ep_size, C, D).transpose(1, 0, 2, 3)
         y = jax.lax.all_to_all(y, ep_axis, split_axis=0, concat_axis=0)
         y = y.reshape(E, C, D)
-    combine_t = dispatch_t * gate[:, None, None]
     out = jnp.einsum("ecd,sec->sd", y, combine_t)
     return out.astype(x.dtype), aux.astype(jnp.float32)
 
@@ -104,6 +114,7 @@ class SwitchMoE(nn.Module):
     ep_axis: str = "ep"
     capacity_factor: float = 1.25
     dtype: jnp.dtype = jnp.bfloat16
+    top_k: int = 1  # 1 = Switch, 2 = GShard-style
 
     @nn.compact
     def __call__(self, x):  # [B, T, D] -> [B, T, D]; aux is SOWN
@@ -128,6 +139,7 @@ class SwitchMoE(nn.Module):
             ep_axis=self.ep_axis if self.ep_size > 1 else None,
             capacity_factor=self.capacity_factor,
             dtype=self.dtype,
+            top_k=self.top_k,
         )
         self.sow("intermediates", "moe_aux", aux)
         return y.reshape(B, T, D)

@@ -131,3 +131,92 @@ def test_moe_lm_single_device_apply_matches_expectations():
     auxs = jax.tree.leaves(state["intermediates"])
     assert len(auxs) == 3  # one per layer
     assert all(np.isfinite(float(a)) for a in auxs)
+
+
+def test_top2_sharded_equals_blockwise_reference():
+    """GShard-style top-2 routing: sharded == per-source-block unsharded,
+    including capacity priority of first choices."""
+    x, router, w1, b1, w2, b2 = make_layer_inputs(seed=2)
+
+    def reference(cf):
+        ys, auxs = [], []
+        for i in range(EP):
+            xi = x[i * S_LOCAL : (i + 1) * S_LOCAL]
+            y, aux = switch_moe(
+                xi, router, w1, b1, w2, b2, ep_size=1, ep_axis=None,
+                capacity_factor=cf, dtype=jnp.float32, top_k=2,
+            )
+            ys.append(np.asarray(y))
+            auxs.append(float(aux))
+        return np.concatenate(ys), float(np.mean(auxs))
+
+    mesh = make_mesh({"ep": EP})
+
+    def body(x, router, w1, b1, w2, b2):
+        y, aux = switch_moe(
+            x, router, w1, b1, w2, b2, ep_size=EP, ep_axis="ep",
+            capacity_factor=1.0, dtype=jnp.float32, top_k=2,
+        )
+        return y, jax.lax.pmean(aux, "ep")
+
+    f = jax.jit(shard_map(
+        body, mesh=mesh,
+        in_specs=(P("ep"), P(), P("ep"), P("ep"), P("ep"), P("ep")),
+        out_specs=(P("ep"), P()),
+    ))
+    y_ref, aux_ref = reference(1.0)
+    y_sh, aux_sh = f(x, router, w1, b1, w2, b2)
+    np.testing.assert_allclose(np.asarray(y_sh), y_ref, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(float(aux_sh), aux_ref, rtol=1e-5)
+
+
+def test_top2_uses_two_experts_per_token():
+    """With ample capacity, top-2 output is the gate-weighted mix of the
+    two best experts (verified against a dense manual computation)."""
+    rng = np.random.default_rng(3)
+    S, D2, F2, E2 = 16, 8, 12, 4
+    x = rng.normal(size=(S, D2)).astype(np.float32)
+    router = rng.normal(size=(D2, E2)).astype(np.float32)
+    w1 = rng.normal(size=(E2, D2, F2)).astype(np.float32) * 0.2
+    b1 = np.zeros((E2, F2), np.float32)
+    w2 = rng.normal(size=(E2, F2, D2)).astype(np.float32) * 0.2
+    b2 = np.zeros((E2, D2), np.float32)
+    y, _ = switch_moe(x, router, w1, b1, w2, b2, ep_size=1, ep_axis=None,
+                      capacity_factor=8.0, dtype=jnp.float32, top_k=2)
+    # dense manual: every expert on every token, mix top-2 renormalized
+    probs = np.asarray(jax.nn.softmax(x @ router, axis=-1))
+    order = np.argsort(-probs, axis=-1)[:, :2]
+    expert_out = np.stack([
+        np.tanh(0) * 0 + (jax.nn.gelu(x @ w1[e] + b1[e]) @ w2[e] + b2[e])
+        for e in range(E2)
+    ])  # [E, S, D]
+    expert_out = np.asarray(expert_out)
+    ref = np.zeros_like(x)
+    for s_i in range(S):
+        g = probs[s_i, order[s_i]]
+        g = g / g.sum()
+        ref[s_i] = (g[0] * expert_out[order[s_i, 0], s_i]
+                    + g[1] * expert_out[order[s_i, 1], s_i])
+    np.testing.assert_allclose(np.asarray(y), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_moe_lm_top2_trains():
+    mesh = make_mesh({"dp": 2, "ep": 4})
+    kw = dict(vocab_size=64, d_model=32, num_heads=2, num_layers=2,
+              max_len=16, dtype=jnp.float32, moe_experts=8, moe_top_k=2)
+    moe = get_model("moe_lm", ep_size=4, ep_axis="ep", **kw)
+    full = get_model("moe_lm", ep_size=1, **kw)
+    tokens = jnp.asarray(
+        np.random.default_rng(9).integers(0, 64, size=(16, 16)), jnp.int32
+    )
+    params = full.init(jax.random.PRNGKey(0), tokens[:2])
+    optimizer = optax.adam(3e-3)
+    step = make_moe_lm_train_step(moe, optimizer, mesh,
+                                  params_template=params)
+    p, s = params, optimizer.init(params)
+    losses = []
+    for _ in range(10):
+        p, s, loss = step(p, s, tokens)
+        losses.append(float(loss))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses
