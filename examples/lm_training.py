@@ -46,6 +46,11 @@ def main():
     ap.add_argument("--dp", type=int, default=0, help="0 = all devices")
     ap.add_argument("--sp", type=int, default=1)
     ap.add_argument("--tp", type=int, default=1)
+    ap.add_argument("--ep", type=int, default=1,
+                    help="expert parallelism (uses the MoE model)")
+    ap.add_argument("--experts", type=int, default=8)
+    ap.add_argument("--top-k", type=int, default=1,
+                    help="1 = Switch, 2 = GShard routing")
     ap.add_argument("--n", type=int, default=512, help="corpus sequences")
     ap.add_argument("--seq-len", type=int, default=256)
     ap.add_argument("--vocab", type=int, default=256)
@@ -63,20 +68,34 @@ def main():
     from distkeras_tpu.models import get_model
     from distkeras_tpu.trainers import LMTrainer
 
-    dp = args.dp or (len(jax.devices()) // (args.sp * args.tp))
-    axes = {"dp": dp, "sp": args.sp, "tp": args.tp}
+    moe = args.ep > 1
+    dp = args.dp or (len(jax.devices()) //
+                     (args.sp * args.tp * max(args.ep, 1)))
+    axes = {"dp": dp, "sp": args.sp, "tp": args.tp, "ep": args.ep}
     axes = {k: v for k, v in axes.items() if v > 1} or {"dp": 1}
+    if moe:
+        axes.setdefault("ep", args.ep)
 
     tokens = synthetic_corpus(args.n, args.seq_len, args.vocab)
     ds = PartitionedDataset.from_arrays({"tokens": tokens}, num_partitions=1)
 
-    model = get_model(
-        "transformer_lm",
-        vocab_size=args.vocab, d_model=args.d_model, num_heads=args.heads,
-        num_layers=args.layers, max_len=args.seq_len,
-        attention="ring" if args.sp > 1 else "standard",
-        seq_axis="sp", tp_size=args.tp, tp_axis="tp",
-    )
+    if moe:
+        model = get_model(
+            "moe_lm",
+            vocab_size=args.vocab, d_model=args.d_model,
+            num_heads=args.heads, num_layers=args.layers,
+            max_len=args.seq_len, moe_experts=args.experts,
+            moe_top_k=args.top_k, ep_size=args.ep, ep_axis="ep",
+        )
+    else:
+        model = get_model(
+            "transformer_lm",
+            vocab_size=args.vocab, d_model=args.d_model,
+            num_heads=args.heads, num_layers=args.layers,
+            max_len=args.seq_len,
+            attention="ring" if args.sp > 1 else "standard",
+            seq_axis="sp", tp_size=args.tp, tp_axis="tp",
+        )
     trainer = LMTrainer(
         model, axes=axes, batch_size=args.batch_size, num_epoch=args.epochs,
         worker_optimizer="adam", learning_rate=3e-3,

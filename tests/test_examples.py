@@ -91,3 +91,16 @@ def test_bigdata_pipeline_example_smoke(monkeypatch, capsys):
     )
     out = capsys.readouterr().out
     assert "accuracy over 2048 rows" in out
+
+
+def test_lm_training_example_moe_smoke(monkeypatch, capsys):
+    sys.path.insert(0, "examples")
+    run_example(
+        monkeypatch, "lm_training",
+        ["lm_training.py", "--dp", "2", "--ep", "4", "--experts", "8",
+         "--top-k", "2", "--n", "64", "--seq-len", "32", "--d-model", "32",
+         "--heads", "2", "--batch-size", "16", "--epochs", "2",
+         "--vocab", "64"],
+    )
+    out = capsys.readouterr().out
+    assert "tokens/sec" in out
