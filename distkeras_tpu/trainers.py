@@ -1108,6 +1108,7 @@ class LMTrainer(Trainer):
                     raise ValueError(
                         f"MoE training shards (dp, ep) only; drop {bad}"
                     )
+            axes.setdefault("dp", 1)  # the feed spec always names dp
             mesh = make_mesh(axes)
             sp = tp = 1
         else:

@@ -46,8 +46,10 @@ def main():
     ap.add_argument("--dp", type=int, default=0, help="0 = all devices")
     ap.add_argument("--sp", type=int, default=1)
     ap.add_argument("--tp", type=int, default=1)
+    ap.add_argument("--moe", action="store_true",
+                    help="use the Switch-MoE model (implied by --ep > 1)")
     ap.add_argument("--ep", type=int, default=1,
-                    help="expert parallelism (uses the MoE model)")
+                    help="expert parallelism for the MoE model")
     ap.add_argument("--experts", type=int, default=8)
     ap.add_argument("--top-k", type=int, default=1,
                     help="1 = Switch, 2 = GShard routing")
@@ -68,12 +70,14 @@ def main():
     from distkeras_tpu.models import get_model
     from distkeras_tpu.trainers import LMTrainer
 
-    moe = args.ep > 1
-    dp = args.dp or (len(jax.devices()) //
-                     (args.sp * args.tp * max(args.ep, 1)))
+    moe = args.moe or args.ep > 1
+    dp = args.dp or max(1, len(jax.devices()) //
+                        (args.sp * args.tp * max(args.ep, 1)))
     axes = {"dp": dp, "sp": args.sp, "tp": args.tp, "ep": args.ep}
     axes = {k: v for k, v in axes.items() if v > 1} or {"dp": 1}
     if moe:
+        # the MoE mesh always carries dp and ep, size-1 or not
+        axes.setdefault("dp", 1)
         axes.setdefault("ep", args.ep)
 
     tokens = synthetic_corpus(args.n, args.seq_len, args.vocab)
