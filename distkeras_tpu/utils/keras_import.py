@@ -20,8 +20,9 @@ Supported layers (the reference's example vocabulary): Dense, Conv2D,
 Flatten, Reshape, MaxPooling2D, AveragePooling2D, Dropout (identity —
 framework losses regularize elsewhere), BatchNormalization (moving
 statistics folded into a frozen affine — exact at inference),
-Activation/ReLU/Softmax, InputLayer. Anything else raises with the layer
-name so the user knows what to port by hand.
+Activation/ReLU/Softmax, LSTM (Keras gate order/weight layout, scanned),
+InputLayer. Anything else raises with the layer name so the user knows
+what to port by hand.
 
 Training note: the reference's models end in ``softmax`` and train with
 Keras' probability-input crossentropy; this framework's losses fold the
@@ -61,6 +62,56 @@ def _act(name):
             f"Unsupported Keras activation '{name}'. "
             f"Known: {sorted(k for k in _ACTIVATIONS if k)}"
         ) from None
+
+
+class _KerasLSTM(nn.Module):
+    """LSTM with Keras' exact weight layout and gate order.
+
+    One fused kernel ``[in, 4u]`` + recurrent kernel ``[u, 4u]`` + bias
+    ``[4u]``, gates ordered (i, f, c~, o) — so ``get_weights()`` arrays
+    drop straight in (see :func:`build_params`). The time loop is a
+    ``lax.scan`` (single XLA program, static shapes).
+    """
+
+    units: int
+    return_sequences: bool = False
+    use_bias: bool = True
+    activation: str = "tanh"
+    recurrent_activation: str = "sigmoid"
+
+    @nn.compact
+    def __call__(self, x):  # [B, T, in]
+        B, T, I = x.shape
+        u = self.units
+        kernel = self.param(
+            "kernel", nn.initializers.lecun_normal(), (I, 4 * u), jnp.float32
+        )
+        recurrent = self.param(
+            "recurrent",
+            nn.initializers.orthogonal(), (u, 4 * u), jnp.float32,
+        )
+        bias = (self.param("bias", nn.initializers.zeros, (4 * u,),
+                           jnp.float32)
+                if self.use_bias else jnp.zeros((4 * u,), jnp.float32))
+        act = _act(self.activation)
+        rec_act = _act(self.recurrent_activation)
+
+        def step(carry, xt):
+            h, c = carry
+            z = xt @ kernel + h @ recurrent + bias
+            i_g = rec_act(z[:, :u])
+            f_g = rec_act(z[:, u:2 * u])
+            c_t = act(z[:, 2 * u:3 * u])
+            o_g = rec_act(z[:, 3 * u:])
+            c = f_g * c + i_g * c_t
+            h = o_g * act(c)
+            return (h, c), h
+
+        h0 = jnp.zeros((B, u), jnp.float32)
+        (h, _), hs = jax.lax.scan(
+            step, (h0, h0), x.transpose(1, 0, 2)
+        )
+        return hs.transpose(1, 0, 2) if self.return_sequences else h
 
 
 class _FrozenAffine(nn.Module):
@@ -138,6 +189,17 @@ class KerasImported(nn.Module):
                 # inference-mode BN folded to a frozen affine (exact for
                 # prediction; a frozen affine under further training)
                 x = _FrozenAffine(name=name)(x)
+            elif kind == "lstm":
+                x = _KerasLSTM(
+                    units=cfg["units"],
+                    return_sequences=cfg.get("return_sequences", False),
+                    use_bias=cfg.get("use_bias", True),
+                    activation=cfg.get("activation", "tanh"),
+                    recurrent_activation=cfg.get(
+                        "recurrent_activation", "sigmoid"
+                    ),
+                    name=name,
+                )(x)
             elif kind == "dropout":
                 pass  # identity at inference; framework trains without it
             else:
@@ -157,6 +219,7 @@ _KERAS_KIND = {
     "Softmax": "activation",
     "Dropout": "dropout",
     "BatchNormalization": "batchnorm",
+    "LSTM": "lstm",
 }
 
 _KEPT_KEYS = {
@@ -170,6 +233,8 @@ _KEPT_KEYS = {
     "flatten": (),
     "dropout": (),
     "batchnorm": ("epsilon", "center", "scale"),
+    "lstm": ("units", "activation", "recurrent_activation",
+             "return_sequences", "use_bias"),
 }
 
 
@@ -228,7 +293,7 @@ def build_params(spec, weights: Sequence[np.ndarray]) -> Dict[str, Any]:
     weights = list(weights)
     params: Dict[str, Any] = {}
     for i, (kind, cfg_items) in enumerate(spec):
-        if kind not in ("dense", "conv2d", "batchnorm"):
+        if kind not in ("dense", "conv2d", "batchnorm", "lstm"):
             continue
         cfg = dict(cfg_items)
         if kind == "batchnorm":
@@ -246,6 +311,15 @@ def build_params(spec, weights: Sequence[np.ndarray]) -> Dict[str, Any]:
                 "scale": jnp.asarray(scale, jnp.float32),
                 "bias": jnp.asarray(bias, jnp.float32),
             }
+            continue
+        if kind == "lstm":
+            entry = {
+                "kernel": jnp.asarray(weights.pop(0), jnp.float32),
+                "recurrent": jnp.asarray(weights.pop(0), jnp.float32),
+            }
+            if cfg.get("use_bias", True):
+                entry["bias"] = jnp.asarray(weights.pop(0), jnp.float32)
+            params[f"layer_{i}"] = entry
             continue
         entry = {"kernel": jnp.asarray(weights.pop(0), jnp.float32)}
         if cfg.get("use_bias", True):

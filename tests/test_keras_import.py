@@ -146,9 +146,9 @@ def test_batchnorm_folds_to_frozen_affine():
 def test_unsupported_layers_raise_with_names():
     km = keras.Sequential([
         keras.layers.Input((4, 16)),
-        keras.layers.LSTM(8),
+        keras.layers.GRU(8),
     ])
-    with pytest.raises(ValueError, match="LSTM"):
+    with pytest.raises(ValueError, match="GRU"):
         from_keras(km)
 
 
@@ -160,6 +160,39 @@ def test_precision_knob_accepted():
     km = seq_mlp()
     model = from_keras(km, precision="highest")
     x = np.random.default_rng(6).normal(size=(8, 16)).astype(np.float32)
+    np.testing.assert_allclose(
+        model.predict(x), km.predict(x, verbose=0), rtol=1e-4, atol=1e-5
+    )
+
+
+def test_lstm_predictions_match_keras():
+    """LSTM imports with Keras' fused weight layout and (i,f,c,o) gate
+    order; sequence and last-state modes both match."""
+    for return_sequences in (False, True):
+        km = keras.Sequential([
+            keras.layers.Input((12, 6)),
+            keras.layers.LSTM(16, return_sequences=return_sequences),
+            keras.layers.Dense(3, activation="softmax") if not return_sequences
+            else keras.layers.Dense(3),
+        ])
+        model = from_keras(km)
+        x = np.random.default_rng(8).normal(size=(10, 12, 6)).astype(np.float32)
+        np.testing.assert_allclose(
+            model.predict(x), km.predict(x, verbose=0),
+            rtol=1e-4, atol=1e-5,
+            err_msg=f"return_sequences={return_sequences}",
+        )
+
+
+def test_stacked_lstm_matches_keras():
+    km = keras.Sequential([
+        keras.layers.Input((8, 4)),
+        keras.layers.LSTM(8, return_sequences=True),
+        keras.layers.LSTM(6),
+        keras.layers.Dense(2),
+    ])
+    model = from_keras(km)
+    x = np.random.default_rng(9).normal(size=(5, 8, 4)).astype(np.float32)
     np.testing.assert_allclose(
         model.predict(x), km.predict(x, verbose=0), rtol=1e-4, atol=1e-5
     )
