@@ -20,8 +20,8 @@ Supported layers (the reference's example vocabulary): Dense, Conv2D,
 Flatten, Reshape, MaxPooling2D, AveragePooling2D, Dropout (identity —
 framework losses regularize elsewhere), BatchNormalization (moving
 statistics folded into a frozen affine — exact at inference),
-Activation/ReLU/Softmax, LSTM (Keras gate order/weight layout, scanned),
-InputLayer. Anything else raises with the layer name so the user knows
+Activation/ReLU/Softmax, LSTM and GRU (Keras gate order/weight layout,
+scanned), InputLayer. Anything else raises with the layer name so the user knows
 what to port by hand.
 
 Training note: the reference's models end in ``softmax`` and train with
@@ -114,6 +114,61 @@ class _KerasLSTM(nn.Module):
         return hs.transpose(1, 0, 2) if self.return_sequences else h
 
 
+class _KerasGRU(nn.Module):
+    """GRU with Keras' weight layout, gate order (z, r, h~), and both
+    ``reset_after`` conventions (True is the Keras default and carries a
+    ``[2, 3u]`` bias: input-side and recurrent-side)."""
+
+    units: int
+    return_sequences: bool = False
+    use_bias: bool = True
+    reset_after: bool = True
+    activation: str = "tanh"
+    recurrent_activation: str = "sigmoid"
+
+    @nn.compact
+    def __call__(self, x):  # [B, T, in]
+        B, T, I = x.shape
+        u = self.units
+        kernel = self.param(
+            "kernel", nn.initializers.lecun_normal(), (I, 3 * u), jnp.float32
+        )
+        recurrent = self.param(
+            "recurrent", nn.initializers.orthogonal(), (u, 3 * u),
+            jnp.float32,
+        )
+        if self.use_bias:
+            bshape = (2, 3 * u) if self.reset_after else (3 * u,)
+            bias = self.param(
+                "bias", nn.initializers.zeros, bshape, jnp.float32
+            )
+        else:
+            bias = None
+        b_in = (bias[0] if (bias is not None and self.reset_after)
+                else (bias if bias is not None else 0.0))
+        b_rec = (bias[1] if (bias is not None and self.reset_after) else 0.0)
+        act = _act(self.activation)
+        rec_act = _act(self.recurrent_activation)
+
+        def step(h, xt):
+            zx = xt @ kernel + b_in
+            if self.reset_after:
+                zh = h @ recurrent + b_rec
+                z = rec_act(zx[:, :u] + zh[:, :u])
+                r = rec_act(zx[:, u:2 * u] + zh[:, u:2 * u])
+                hh = act(zx[:, 2 * u:] + r * zh[:, 2 * u:])
+            else:
+                z = rec_act(zx[:, :u] + h @ recurrent[:, :u])
+                r = rec_act(zx[:, u:2 * u] + h @ recurrent[:, u:2 * u])
+                hh = act(zx[:, 2 * u:] + (r * h) @ recurrent[:, 2 * u:])
+            h = z * h + (1.0 - z) * hh
+            return h, h
+
+        h0 = jnp.zeros((B, u), jnp.float32)
+        h, hs = jax.lax.scan(step, h0, x.transpose(1, 0, 2))
+        return hs.transpose(1, 0, 2) if self.return_sequences else h
+
+
 class _FrozenAffine(nn.Module):
     """Inference-mode BatchNormalization: moving statistics folded into a
     per-channel scale/bias by :func:`build_params`."""
@@ -189,6 +244,18 @@ class KerasImported(nn.Module):
                 # inference-mode BN folded to a frozen affine (exact for
                 # prediction; a frozen affine under further training)
                 x = _FrozenAffine(name=name)(x)
+            elif kind == "gru":
+                x = _KerasGRU(
+                    units=cfg["units"],
+                    return_sequences=cfg.get("return_sequences", False),
+                    use_bias=cfg.get("use_bias", True),
+                    reset_after=cfg.get("reset_after", True),
+                    activation=cfg.get("activation", "tanh"),
+                    recurrent_activation=cfg.get(
+                        "recurrent_activation", "sigmoid"
+                    ),
+                    name=name,
+                )(x)
             elif kind == "lstm":
                 x = _KerasLSTM(
                     units=cfg["units"],
@@ -220,6 +287,7 @@ _KERAS_KIND = {
     "Dropout": "dropout",
     "BatchNormalization": "batchnorm",
     "LSTM": "lstm",
+    "GRU": "gru",
 }
 
 _KEPT_KEYS = {
@@ -235,6 +303,8 @@ _KEPT_KEYS = {
     "batchnorm": ("epsilon", "center", "scale"),
     "lstm": ("units", "activation", "recurrent_activation",
              "return_sequences", "use_bias"),
+    "gru": ("units", "activation", "recurrent_activation",
+            "return_sequences", "use_bias", "reset_after"),
 }
 
 
@@ -293,7 +363,7 @@ def build_params(spec, weights: Sequence[np.ndarray]) -> Dict[str, Any]:
     weights = list(weights)
     params: Dict[str, Any] = {}
     for i, (kind, cfg_items) in enumerate(spec):
-        if kind not in ("dense", "conv2d", "batchnorm", "lstm"):
+        if kind not in ("dense", "conv2d", "batchnorm", "lstm", "gru"):
             continue
         cfg = dict(cfg_items)
         if kind == "batchnorm":
@@ -312,7 +382,7 @@ def build_params(spec, weights: Sequence[np.ndarray]) -> Dict[str, Any]:
                 "bias": jnp.asarray(bias, jnp.float32),
             }
             continue
-        if kind == "lstm":
+        if kind in ("lstm", "gru"):
             entry = {
                 "kernel": jnp.asarray(weights.pop(0), jnp.float32),
                 "recurrent": jnp.asarray(weights.pop(0), jnp.float32),

@@ -143,12 +143,27 @@ def test_batchnorm_folds_to_frozen_affine():
     )
 
 
+def test_gru_predictions_match_keras():
+    for reset_after in (True, False):
+        km = keras.Sequential([
+            keras.layers.Input((10, 5)),
+            keras.layers.GRU(12, reset_after=reset_after),
+            keras.layers.Dense(3),
+        ])
+        model = from_keras(km)
+        x = np.random.default_rng(10).normal(size=(6, 10, 5)).astype(np.float32)
+        np.testing.assert_allclose(
+            model.predict(x), km.predict(x, verbose=0),
+            rtol=1e-4, atol=1e-5, err_msg=f"reset_after={reset_after}",
+        )
+
+
 def test_unsupported_layers_raise_with_names():
     km = keras.Sequential([
         keras.layers.Input((4, 16)),
-        keras.layers.GRU(8),
+        keras.layers.Conv1D(8, 3),
     ])
-    with pytest.raises(ValueError, match="GRU"):
+    with pytest.raises(ValueError, match="Conv1D"):
         from_keras(km)
 
 
