@@ -169,6 +169,19 @@ class _KerasGRU(nn.Module):
         return hs.transpose(1, 0, 2) if self.return_sequences else h
 
 
+class _KerasEmbedding(nn.Module):
+    input_dim: int
+    output_dim: int
+
+    @nn.compact
+    def __call__(self, x):
+        table = self.param(
+            "embeddings", nn.initializers.normal(0.02),
+            (self.input_dim, self.output_dim), jnp.float32,
+        )
+        return jnp.take(table, x.astype(jnp.int32), axis=0)
+
+
 class _FrozenAffine(nn.Module):
     """Inference-mode BatchNormalization: moving statistics folded into a
     per-channel scale/bias by :func:`build_params`."""
@@ -204,7 +217,9 @@ class KerasImported(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        x = jnp.asarray(x, jnp.float32)
+        x = jnp.asarray(x)
+        if not self.layers or self.layers[0][0] != "embedding":
+            x = x.astype(jnp.float32)  # int token ids feed embeddings as-is
         for i, (kind, cfg_items) in enumerate(self.layers):
             cfg = dict(cfg_items)
             name = f"layer_{i}"
@@ -224,6 +239,20 @@ class KerasImported(nn.Module):
                     precision=self.precision, name=name,
                 )(x)
                 x = _act(cfg.get("activation"))(x)
+            elif kind == "conv1d":
+                x = nn.Conv(
+                    cfg["filters"],
+                    kernel_size=tuple(cfg["kernel_size"]),
+                    strides=tuple(cfg.get("strides", (1,))),
+                    padding=cfg.get("padding", "valid").upper(),
+                    use_bias=cfg.get("use_bias", True),
+                    precision=self.precision, name=name,
+                )(x)
+                x = _act(cfg.get("activation"))(x)
+            elif kind == "embedding":
+                x = _KerasEmbedding(
+                    cfg["input_dim"], cfg["output_dim"], name=name
+                )(x)
             elif kind == "flatten":
                 x = x.reshape((x.shape[0], -1))
             elif kind == "reshape":
@@ -277,6 +306,8 @@ class KerasImported(nn.Module):
 _KERAS_KIND = {
     "Dense": "dense",
     "Conv2D": "conv2d",
+    "Conv1D": "conv1d",
+    "Embedding": "embedding",
     "Flatten": "flatten",
     "Reshape": "reshape",
     "MaxPooling2D": "maxpool2d",
@@ -294,6 +325,9 @@ _KEPT_KEYS = {
     "dense": ("units", "activation", "use_bias"),
     "conv2d": ("filters", "kernel_size", "strides", "padding",
                "activation", "use_bias"),
+    "conv1d": ("filters", "kernel_size", "strides", "padding",
+               "activation", "use_bias"),
+    "embedding": ("input_dim", "output_dim"),
     "reshape": ("target_shape",),
     "maxpool2d": ("pool_size", "strides", "padding"),
     "avgpool2d": ("pool_size", "strides", "padding"),
@@ -363,7 +397,8 @@ def build_params(spec, weights: Sequence[np.ndarray]) -> Dict[str, Any]:
     weights = list(weights)
     params: Dict[str, Any] = {}
     for i, (kind, cfg_items) in enumerate(spec):
-        if kind not in ("dense", "conv2d", "batchnorm", "lstm", "gru"):
+        if kind not in ("dense", "conv2d", "conv1d", "batchnorm", "lstm",
+                        "gru", "embedding"):
             continue
         cfg = dict(cfg_items)
         if kind == "batchnorm":
@@ -380,6 +415,11 @@ def build_params(spec, weights: Sequence[np.ndarray]) -> Dict[str, Any]:
             params[f"layer_{i}"] = {
                 "scale": jnp.asarray(scale, jnp.float32),
                 "bias": jnp.asarray(bias, jnp.float32),
+            }
+            continue
+        if kind == "embedding":
+            params[f"layer_{i}"] = {
+                "embeddings": jnp.asarray(weights.pop(0), jnp.float32)
             }
             continue
         if kind in ("lstm", "gru"):

@@ -160,10 +160,10 @@ def test_gru_predictions_match_keras():
 
 def test_unsupported_layers_raise_with_names():
     km = keras.Sequential([
-        keras.layers.Input((4, 16)),
-        keras.layers.Conv1D(8, 3),
+        keras.layers.Input((8, 8, 3)),
+        keras.layers.SeparableConv2D(8, 3),
     ])
-    with pytest.raises(ValueError, match="Conv1D"):
+    with pytest.raises(ValueError, match="SeparableConv2D"):
         from_keras(km)
 
 
@@ -208,6 +208,37 @@ def test_stacked_lstm_matches_keras():
     ])
     model = from_keras(km)
     x = np.random.default_rng(9).normal(size=(5, 8, 4)).astype(np.float32)
+    np.testing.assert_allclose(
+        model.predict(x), km.predict(x, verbose=0), rtol=1e-4, atol=1e-5
+    )
+
+
+def test_text_model_embedding_lstm_matches_keras():
+    """The classic Keras text stack — Embedding -> LSTM -> Dense — imports
+    wholesale with integer token inputs."""
+    km = keras.Sequential([
+        keras.layers.Input((12,)),
+        keras.layers.Embedding(50, 8),
+        keras.layers.LSTM(16),
+        keras.layers.Dense(2, activation="softmax"),
+    ])
+    model = from_keras(km)
+    x = np.random.default_rng(12).integers(0, 50, size=(6, 12)).astype(np.int32)
+    np.testing.assert_allclose(
+        model.predict(x), km.predict(x, verbose=0), rtol=1e-4, atol=1e-5
+    )
+
+
+def test_conv1d_matches_keras():
+    km = keras.Sequential([
+        keras.layers.Input((20, 4)),
+        keras.layers.Conv1D(8, 3, padding="same", activation="relu"),
+        keras.layers.Conv1D(6, 5, padding="valid"),
+        keras.layers.Flatten(),
+        keras.layers.Dense(3),
+    ])
+    model = from_keras(km)
+    x = np.random.default_rng(13).normal(size=(5, 20, 4)).astype(np.float32)
     np.testing.assert_allclose(
         model.predict(x), km.predict(x, verbose=0), rtol=1e-4, atol=1e-5
     )
