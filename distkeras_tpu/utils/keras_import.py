@@ -20,8 +20,8 @@ Supported layers (the reference's example vocabulary): Dense, Conv2D,
 Flatten, Reshape, MaxPooling2D, AveragePooling2D, Dropout (identity —
 framework losses regularize elsewhere), BatchNormalization (moving
 statistics folded into a frozen affine — exact at inference),
-Activation/ReLU/Softmax, LSTM and GRU (Keras gate order/weight layout,
-scanned), InputLayer. Anything else raises with the layer name so the user knows
+Activation/ReLU/Softmax, Conv1D, Embedding (integer token inputs), LSTM
+and GRU (Keras gate order/weight layout, scanned), InputLayer. Anything else raises with the layer name so the user knows
 what to port by hand.
 
 Training note: the reference's models end in ``softmax`` and train with
@@ -158,8 +158,9 @@ class _KerasGRU(nn.Module):
                 r = rec_act(zx[:, u:2 * u] + zh[:, u:2 * u])
                 hh = act(zx[:, 2 * u:] + r * zh[:, 2 * u:])
             else:
-                z = rec_act(zx[:, :u] + h @ recurrent[:, :u])
-                r = rec_act(zx[:, u:2 * u] + h @ recurrent[:, u:2 * u])
+                zh = h @ recurrent[:, :2 * u]  # one fused dot for z and r
+                z = rec_act(zx[:, :u] + zh[:, :u])
+                r = rec_act(zx[:, u:2 * u] + zh[:, u:])
                 hh = act(zx[:, 2 * u:] + (r * h) @ recurrent[:, 2 * u:])
             h = z * h + (1.0 - z) * hh
             return h, h
@@ -342,6 +343,30 @@ _KEPT_KEYS = {
 }
 
 
+# config keys whose NON-DEFAULT values change semantics this importer does
+# not reproduce — importing would silently diverge from Keras, so raise
+_STRICT_DEFAULTS = {
+    "embedding": {"mask_zero": False},
+    "conv1d": {"dilation_rate": (1,), "groups": 1},
+    "conv2d": {"dilation_rate": (1, 1), "groups": 1},
+    "lstm": {"go_backwards": False, "stateful": False, "unroll": False},
+    "gru": {"go_backwards": False, "stateful": False, "unroll": False},
+}
+
+
+def _check_strict(kind: str, cls: str, cfg: Dict[str, Any]):
+    for key, default in _STRICT_DEFAULTS.get(kind, {}).items():
+        val = cfg.get(key, default)
+        norm = tuple(val) if isinstance(val, (list, tuple)) else val
+        norm_d = tuple(default) if isinstance(default, (list, tuple)) else default
+        if norm != norm_d:
+            raise ValueError(
+                f"Unsupported {cls} config: {key}={val!r} (only the "
+                f"default {default!r} imports faithfully) — port this "
+                "layer by hand"
+            )
+
+
 def _freeze(v):
     if isinstance(v, (list, tuple)):
         return tuple(_freeze(x) for x in v)
@@ -374,6 +399,7 @@ def keras_config_to_spec(
             cfg = {"activation": "relu"}
         elif cls == "Softmax":
             cfg = {"activation": "softmax"}
+        _check_strict(kind, cls, cfg)
         kept = {
             k: _freeze(cfg[k]) for k in _KEPT_KEYS[kind] if k in cfg
         }

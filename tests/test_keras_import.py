@@ -242,3 +242,26 @@ def test_conv1d_matches_keras():
     np.testing.assert_allclose(
         model.predict(x), km.predict(x, verbose=0), rtol=1e-4, atol=1e-5
     )
+
+
+def test_semantics_bearing_configs_raise():
+    """Non-default config values this importer cannot reproduce must raise
+    instead of silently diverging from Keras."""
+    cases = [
+        keras.Sequential([
+            keras.layers.Input((12,)),
+            keras.layers.Embedding(50, 8, mask_zero=True),
+            keras.layers.LSTM(4),
+        ]),
+        keras.Sequential([
+            keras.layers.Input((20, 4)),
+            keras.layers.Conv1D(8, 3, dilation_rate=2),
+        ]),
+        keras.Sequential([
+            keras.layers.Input((10, 5)),
+            keras.layers.GRU(6, go_backwards=True),
+        ]),
+    ]
+    for km in cases:
+        with pytest.raises(ValueError, match="port this layer by hand"):
+            from_keras(km)
