@@ -27,7 +27,7 @@ import json
 import os
 import queue
 import threading
-from typing import Dict, Iterator, List, Optional, Tuple
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -129,7 +129,48 @@ def write_shards(
         rows = len(next(iter(part.values())))
         meta["shards"].append({"rows": rows})
         for c in columns:
+            want_dtype = np.dtype(meta["columns"][c]["dtype"])
+            want_shape = tuple(meta["columns"][c]["row_shape"])
             arr = np.ascontiguousarray(part[c])
+            if arr.shape[1:] != want_shape:
+                raise ValueError(
+                    f"partition {i} column '{c}': row shape {arr.shape[1:]} "
+                    f"!= partition 0's {want_shape}"
+                )
+            if arr.dtype != want_dtype:
+                # same-kind casts keep the file consistent with meta.json —
+                # but same_kind permits lossy integer narrowing and float
+                # overflow-to-inf, so value-check anything not float→float
+                if not np.can_cast(arr.dtype, want_dtype, casting="same_kind"):
+                    raise ValueError(
+                        f"partition {i} column '{c}': dtype {arr.dtype} is "
+                        f"incompatible with partition 0's {want_dtype}"
+                    )
+                cast = arr.astype(want_dtype)
+                if arr.dtype.kind in "iu" and want_dtype.kind in "iu":
+                    # range check, not round-trip: signed↔unsigned wrap is
+                    # bijective, so a round-trip would pass on wrapped data
+                    info = np.iinfo(want_dtype)
+                    if arr.size and not (
+                        info.min <= int(arr.min())
+                        and int(arr.max()) <= info.max
+                    ):
+                        raise ValueError(
+                            f"partition {i} column '{c}': values do not "
+                            f"survive the {arr.dtype}→{want_dtype} cast"
+                        )
+                elif want_dtype.kind in "iu" or arr.dtype.kind in "iu":
+                    if not np.array_equal(cast.astype(arr.dtype), arr):
+                        raise ValueError(
+                            f"partition {i} column '{c}': values do not "
+                            f"survive the {arr.dtype}→{want_dtype} cast"
+                        )
+                elif not np.all(np.isfinite(cast) == np.isfinite(arr)):
+                    raise ValueError(
+                        f"partition {i} column '{c}': {arr.dtype}→"
+                        f"{want_dtype} overflows to inf"
+                    )
+                arr = cast
             arr.tofile(os.path.join(directory, f"shard_{i:05d}.{c}.bin"))
     with open(os.path.join(directory, "meta.json"), "w") as fh:
         json.dump(meta, fh)
@@ -241,6 +282,7 @@ class ShardedDataset:
         cast_bf16: Optional[List[str]] = None,
         prefetch: int = 2,
         drop_remainder: bool = True,
+        shards: Optional[Sequence[int]] = None,
     ) -> Iterator[Dict[str, np.ndarray]]:
         """Stream fixed-shape batches shard by shard.
 
@@ -250,11 +292,18 @@ class ShardedDataset:
         float32 columns to cast during assembly (fused in C). A background
         thread prefetches ``prefetch`` batches ahead; IO and assembly run
         GIL-released, overlapping the consumer's device dispatch.
+
+        ``shards`` restricts the stream to a subset of shard indices —
+        the hook multi-process trainers use to give each process a
+        disjoint slice of the directory (shuffle then permutes within
+        the subset only).
         """
         cast_cols = set(cast_bf16 or ())
         rng = (np.random.default_rng(shuffle_seed)
                if shuffle_seed is not None else None)
-        shard_order = np.arange(self.num_shards)
+        shard_order = (np.asarray(list(shards), dtype=np.int64)
+                       if shards is not None
+                       else np.arange(self.num_shards))
         if rng is not None:
             rng.shuffle(shard_order)
 
@@ -408,9 +457,23 @@ def map_shards(dataset: ShardedDataset, fn, out_directory: str) -> str:
                 }
                 for c, v in out.items()
             }
+        elif sorted(out) != sorted(meta["columns"]):
+            raise ValueError(
+                f"map_shards fn returned columns {sorted(out)} for shard "
+                f"{i}, but shard 0 produced {sorted(meta['columns'])}"
+            )
         meta["shards"].append({"rows": rows.pop()})
         for c, v in out.items():
-            np.ascontiguousarray(v).tofile(
+            arr = np.ascontiguousarray(v)
+            want = meta["columns"][c]
+            if arr.dtype.str != want["dtype"] or \
+                    list(arr.shape[1:]) != want["row_shape"]:
+                raise ValueError(
+                    f"map_shards fn output for shard {i} column '{c}' is "
+                    f"{arr.dtype.str}/{list(arr.shape[1:])}, but shard 0 "
+                    f"produced {want['dtype']}/{want['row_shape']}"
+                )
+            arr.tofile(
                 os.path.join(out_directory, f"shard_{i:05d}.{c}.bin")
             )
     with open(os.path.join(out_directory, "meta.json"), "w") as fh:

@@ -976,12 +976,58 @@ class DataParallelTrainer(Trainer):
 
         staged = False
         if sharded:
+            # Multi-process: each process streams a DISJOINT stride of the
+            # shard directory (ADVICE r2 #4 — a shared seed would otherwise
+            # feed every process identical rows, silently duplicating data
+            # across the global batch).
+            my_shards = None
+            batch_cap = None
+            if multiproc:
+                pi, pc = jax.process_index(), jax.process_count()
+                if dataset.num_shards < pc:
+                    raise ValueError(
+                        f"sharded multi-process training needs >= "
+                        f"{pc} shards (one per process); directory has "
+                        f"{dataset.num_shards} — rewrite with a smaller "
+                        "rows_per_shard"
+                    )
+                my_shards = list(range(pi, dataset.num_shards, pc))
+                # Every process must enter the collective step the SAME
+                # number of times: truncate all streams to the smallest
+                # per-process batch count (known from meta, no IO) so
+                # unequal shard row-sums can't desynchronize shard_map.
+                # Each process p feeds its OWN device count's share of a
+                # global batch, so its batch capacity divides by ITS
+                # feed size, not ours (uneven meshes are supported).
+                feed_of = [0] * pc
+                for dv in mesh.devices.flat:
+                    feed_of[dv.process_index] += 1
+                batch_cap = min(
+                    sum(dataset.shard_rows[s]
+                        for s in range(p, dataset.num_shards, pc))
+                    // (self.batch_size * feed_of[p])
+                    for p in range(pc) if feed_of[p] > 0
+                )
+                if batch_cap == 0:
+                    raise ValueError(
+                        "some process's shard slice holds fewer rows than "
+                        "its share of one global batch "
+                        f"(batch_size={self.batch_size} × its device "
+                        "count) — use smaller batches or rebalance the "
+                        "shard directory"
+                    )
+
             def epoch_chunks(epoch):
                 seed = self.seed + epoch if shuffle else None
                 bx, by = [], []
+                n_seen = 0
                 for b in dataset.batches(
-                    self.batch_size * feed_dev, shuffle_seed=seed
+                    self.batch_size * feed_dev, shuffle_seed=seed,
+                    shards=my_shards,
                 ):
+                    if batch_cap is not None and n_seen >= batch_cap:
+                        break
+                    n_seen += 1
                     bx.append(b[self.features_col])
                     by.append(b[self.label_col])
                     if len(bx) == self.STREAM_GROUP:

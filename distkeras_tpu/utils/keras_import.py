@@ -33,7 +33,7 @@ the native losses; leave it False for bit-faithful inference parity.
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
 import flax.linen as nn
 import jax
@@ -374,14 +374,24 @@ def _freeze(v):
 
 
 def keras_config_to_spec(
-    config: Dict[str, Any], strip_final_softmax: bool = False
+    config: Union[Dict[str, Any], List[Dict[str, Any]]],
+    strip_final_softmax: bool = False,
 ) -> Tuple[Tuple[str, Tuple], ...]:
-    """Keras ``Sequential`` config dict → hashable layer spec tuple."""
-    layer_cfgs = config.get("layers")
+    """Keras ``Sequential`` config → hashable layer spec tuple.
+
+    Accepts both the modern dict form (``{"layers": [...]}``) and the
+    reference-era bare layer list that old ``to_json()`` output used.
+    """
+    if isinstance(config, list):
+        # reference-era Keras serialized a Sequential's config as the bare
+        # layer list (reference: distkeras/utils.py · serialize_keras_model)
+        layer_cfgs = config
+    else:
+        layer_cfgs = config.get("layers")
     if layer_cfgs is None:
         raise ValueError(
-            "expected a Sequential config with a 'layers' list; functional "
-            "graphs are not supported — rebuild with the native model zoo"
+            "expected a Sequential config with a 'layers' list (or the "
+            "reference-era bare layer list)"
         )
     spec: List[Tuple[str, Tuple]] = []
     for lc in layer_cfgs:
@@ -470,12 +480,12 @@ def build_params(spec, weights: Sequence[np.ndarray]) -> Dict[str, Any]:
 
 
 def from_keras_config(
-    config: Dict[str, Any],
+    config: Union[Dict[str, Any], List[Dict[str, Any]]],
     weights: Sequence[np.ndarray],
     strip_final_softmax: bool = False,
     precision: Optional[str] = None,
 ):
-    """(Sequential config dict, weight list) → framework ``Model``.
+    """(Sequential config dict or bare layer list, weight list) → framework ``Model``.
 
     Works without Keras installed — this is the pure-data path for the
     reference's ``{'model': to_json(), 'weights': get_weights()}`` format:

@@ -132,10 +132,24 @@ def test_dynsgd_clock_and_staleness_invariants():
     log = ps.staleness_log
     assert len(log) == total
     assert all(0 <= s < total for s in log)
-    # with 8 racing workers, SOME staleness must have been observed, and a
-    # worker can be at most (N_WORKERS - 1) commits behind per round-trip
-    # window times its own window count — sanity-bound it loosely
-    assert max(log) >= 1
+
+
+def test_dynsgd_staleness_forced_interleaving():
+    """Deterministic staleness: worker A pulls its clock, worker B commits
+    TWICE while A is parked, then A commits with its stale clock — staleness
+    is exactly 2 by construction, not by scheduler luck."""
+    ps = DynSGDParameterServer(int_tree(0))
+    _, a_clock = ps.pull_with_clock()      # A reads clock = 0
+    ps.commit(int_tree(1), worker=1, worker_clock=ps.clock)  # B: clock -> 1
+    ps.commit(int_tree(1), worker=1, worker_clock=ps.clock)  # B: clock -> 2
+    ps.commit(int_tree(1), worker=0, worker_clock=a_clock)   # A: stale by 2
+    assert ps.staleness_log == [0, 0, 2]
+    assert ps.clock == 3
+    # the stale commit was scaled by 1/(staleness+1) = 1/3
+    expected = 1.0 + 1.0 + 1.0 / 3
+    np.testing.assert_allclose(
+        ps.get_model()["w"], np.full((4, 3), expected), rtol=1e-6
+    )
 
 
 def test_dynsgd_staleness_scaling_math_serial():

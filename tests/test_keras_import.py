@@ -265,3 +265,20 @@ def test_semantics_bearing_configs_raise():
     for km in cases:
         with pytest.raises(ValueError, match="port this layer by hand"):
             from_keras(km)
+
+
+def test_bare_layer_list_config_imports():
+    """ADVICE r2 #1: reference-era Keras serialized a Sequential's config
+    as the bare layer list — accept it, same as the dict form."""
+    from distkeras_tpu.utils.keras_import import keras_config_to_spec
+
+    layers = [
+        {"class_name": "Dense",
+         "config": {"units": 8, "activation": "relu", "use_bias": True}},
+        {"class_name": "Dense",
+         "config": {"units": 2, "activation": "linear", "use_bias": True}},
+    ]
+    spec_list = keras_config_to_spec(layers)
+    spec_dict = keras_config_to_spec({"layers": layers})
+    assert spec_list == spec_dict
+    assert spec_list[0][0] == "dense"

@@ -435,3 +435,129 @@ def test_accuracy_evaluator_streams_shards(tmp_path):
     sd = ShardedDataset(write_shards(ds, str(tmp_path / "s")))
     ev = AccuracyEvaluator()
     assert ev.evaluate(sd) == ev.evaluate(ds) == 0.75
+
+
+def test_write_shards_mismatched_partition_dtype_raises(tmp_path):
+    """ADVICE r2 #2: a partition whose dtype disagrees with partition 0
+    must not be written as raw bytes under the wrong metadata."""
+    parts = [
+        {"features": np.ones((10, 3), np.float32),
+         "label": np.zeros(10, np.int64)},
+        {"features": np.ones((10, 3), np.float32),
+         "label": np.zeros(10, np.float64)},  # int64 -> float64: unsafe
+    ]
+    with pytest.raises(ValueError, match="incompatible"):
+        write_shards(PartitionedDataset(parts), str(tmp_path / "bad"))
+
+
+def test_write_shards_same_kind_dtype_cast_to_meta(tmp_path):
+    """Same-kind dtype drift (float64 in one partition) is cast to the
+    meta dtype so the files stay consistent with meta.json."""
+    parts = [
+        {"x": np.full((4, 2), 1.0, np.float32)},
+        {"x": np.full((4, 2), 2.0, np.float64)},
+    ]
+    sd = ShardedDataset(
+        write_shards(PartitionedDataset(parts), str(tmp_path / "s"))
+    )
+    loaded = sd.load().column("x")
+    assert loaded.dtype == np.float32
+    np.testing.assert_array_equal(loaded[4:], np.full((4, 2), 2.0, np.float32))
+
+
+def test_write_shards_mismatched_row_shape_raises(tmp_path):
+    parts = [
+        {"x": np.ones((4, 3), np.float32)},
+        {"x": np.ones((4, 5), np.float32)},
+    ]
+    with pytest.raises(ValueError, match="row shape"):
+        write_shards(PartitionedDataset(parts), str(tmp_path / "bad"))
+
+
+def test_map_shards_inconsistent_fn_output_raises(tmp_path):
+    """ADVICE r2 #3: fn returning a different dtype for a later shard must
+    raise instead of writing files that disagree with meta.json."""
+    from distkeras_tpu.data.shard_io import map_shards
+
+    ds = make_ds(n=80, parts=2)
+    sd = ShardedDataset(write_shards(ds, str(tmp_path / "in")))
+    calls = []
+
+    def drifting(shard):
+        calls.append(1)
+        dt = np.float32 if len(calls) == 1 else np.float64
+        return {"features": shard["features"].astype(dt)}
+
+    with pytest.raises(ValueError, match="shard 1"):
+        map_shards(sd, drifting, str(tmp_path / "out"))
+
+    def column_drift(shard):
+        if not shard["features"].flags.owndata:
+            shard = dict(shard)
+        # shard 0 emits {a}, shard 1 emits {b}
+        key = "a" if column_drift.n == 0 else "b"
+        column_drift.n += 1
+        return {key: shard["features"]}
+
+    column_drift.n = 0
+    with pytest.raises(ValueError, match="columns"):
+        map_shards(sd, column_drift, str(tmp_path / "out2"))
+
+
+def test_batches_shard_subset_streams_disjoint_slices(tmp_path):
+    """shards= restricts the stream — the multi-process partitioning hook
+    (ADVICE r2 #4). Two strided subsets cover the directory disjointly."""
+    ds = make_ds(n=160, parts=4)
+    sd = ShardedDataset(write_shards(ds, str(tmp_path / "s")))
+    rows_a = np.concatenate([
+        b["label"] for b in sd.batches(8, shards=[0, 2])
+    ])
+    rows_b = np.concatenate([
+        b["label"] for b in sd.batches(8, shards=[1, 3])
+    ])
+    assert len(rows_a) == len(rows_b) == 80
+    full = np.concatenate([
+        ds.partition(i)["label"] for i in (0, 2, 1, 3)
+    ])
+    np.testing.assert_array_equal(np.concatenate([rows_a, rows_b]), full)
+
+
+def test_write_shards_lossy_int_narrowing_raises(tmp_path):
+    """same_kind permits int64->int32, but values that overflow must raise
+    instead of silently wrapping."""
+    parts = [
+        {"ids": np.zeros(4, np.int32)},
+        {"ids": np.full(4, 2**40, np.int64)},
+    ]
+    with pytest.raises(ValueError, match="survive"):
+        write_shards(PartitionedDataset(parts), str(tmp_path / "bad"))
+    # values that DO fit narrow cleanly
+    parts_ok = [
+        {"ids": np.zeros(4, np.int32)},
+        {"ids": np.full(4, 7, np.int64)},
+    ]
+    sd = ShardedDataset(
+        write_shards(PartitionedDataset(parts_ok), str(tmp_path / "ok"))
+    )
+    got = sd.load().column("ids")
+    assert got.dtype == np.int32 and got[-1] == 7
+
+
+def test_write_shards_float_overflow_to_inf_raises(tmp_path):
+    parts = [
+        {"x": np.zeros(4, np.float16)},
+        {"x": np.full(4, 1e30, np.float64)},
+    ]
+    with pytest.raises(ValueError, match="inf"):
+        write_shards(PartitionedDataset(parts), str(tmp_path / "bad"))
+
+
+def test_write_shards_unsigned_wraparound_raises(tmp_path):
+    """uint64 >= 2**63 wraps bijectively into int64 — a round-trip check
+    would pass on corrupted data; the range check must raise."""
+    parts = [
+        {"ids": np.zeros(4, np.int64)},
+        {"ids": np.full(4, 2**63, np.uint64)},
+    ]
+    with pytest.raises(ValueError, match="survive"):
+        write_shards(PartitionedDataset(parts), str(tmp_path / "bad"))
