@@ -126,6 +126,12 @@ def write_shards(
         }
     for i in range(dataset.num_partitions):
         part = dataset.partition(i)
+        if sorted(part) != sorted(columns):
+            raise ValueError(
+                f"partition {i} columns {sorted(part)} != partition 0's "
+                f"{sorted(columns)} — extra columns would be dropped and "
+                "missing ones leave holes in the shard files"
+            )
         rows = len(next(iter(part.values())))
         meta["shards"].append({"rows": rows})
         for c in columns:

@@ -1104,10 +1104,21 @@ class LMTrainer(Trainer):
     """
 
     def __init__(self, model, *args, axes: Optional[dict] = None,
-                 tokens_col: str = "tokens", **kwargs):
+                 tokens_col: str = "tokens",
+                 microbatches: Optional[int] = None, **kwargs):
         super().__init__(model, *args, **kwargs)
-        self.axes = axes  # e.g. {"dp": 4, "sp": 2} or {"dp": 2, "sp": 2, "tp": 2}
+        # e.g. {"dp": 4, "sp": 2}, {"dp": 2, "sp": 2, "tp": 2},
+        # or {"pp": 2, "dp": 4} (GPipe pipeline over the layer stack)
+        self.axes = axes
         self.tokens_col = tokens_col
+        # pipeline (pp) only: microbatches per optimizer step (GPipe M);
+        # default 4*pp keeps the bubble fraction (pp-1)/(M+pp-1) under ~20%
+        self.microbatches = microbatches
+        if microbatches is not None and (axes or {}).get("pp", 1) <= 1:
+            raise ValueError(
+                "microbatches only applies to pipeline training — set "
+                "axes={'pp': ..., 'dp': ...} (or drop microbatches)"
+            )
 
     def _init_params(self, tokens: np.ndarray, sp: int):
         """Full-size host init via a standard-attention twin (ring
@@ -1140,6 +1151,8 @@ class LMTrainer(Trainer):
         if shuffle:
             dataset = dataset.shuffle(seed=self.seed)
         axes = dict(self.axes) if self.axes else {"dp": len(jax.devices())}
+        if axes.get("pp", 1) > 1:
+            return self._train_pp(dataset)
         # an MoE model (ep_size > 1) trains on a (dp, ep) mesh via the
         # MoE step; everything else on dp x sp (x tp) via the LM step
         moe = getattr(self.model, "ep_size", 1) > 1
@@ -1287,6 +1300,178 @@ class LMTrainer(Trainer):
                     force=(epoch + 1 == self.num_epoch),
                 )
         self.params = jax.tree.map(np.asarray, params)
+        self.history = history
+        self.executor_histories = [history]
+        return Model(self.model, self.params)
+
+    def _train_pp(self, dataset: PartitionedDataset) -> Model:
+        """Pipeline-parallel training: ``axes={"pp": ..., "dp": ...}``.
+
+        The layer stack is split into ``pp`` contiguous stages
+        (:func:`distkeras_tpu.parallel.pipeline.make_pp_lm_train_step`);
+        every optimizer step consumes ``batch_size`` rows as ``M``
+        microbatches of ``batch_size / M`` each (``M = self.microbatches``,
+        default ``4 * pp``), batch sharded over ``dp``. Checkpoints store
+        the PLAIN module layout (portable to every other LMTrainer mesh);
+        the pipeline layout exists only on device.
+        """
+        from distkeras_tpu.parallel.mesh import make_mesh
+        from distkeras_tpu.parallel.pipeline import (
+            from_pipeline_params,
+            make_pp_lm_train_step,
+            to_pipeline_params,
+        )
+        from jax.sharding import NamedSharding
+
+        axes = dict(self.axes)
+        pp = axes.pop("pp")
+        for bad in ("sp", "tp", "ep"):
+            if axes.pop(bad, 1) > 1:
+                raise ValueError(
+                    f"pipeline training shards (pp, dp) only; drop '{bad}' "
+                    "(see ARCHITECTURE.md on pp composition)"
+                )
+        dp = axes.pop("dp", 1)
+        if axes:
+            raise ValueError(f"unknown mesh axes with pp: {sorted(axes)}")
+        if (getattr(self.model, "tp_size", 1) != 1
+                or self.model.attention == "ring"
+                or getattr(self.model, "moe_experts", 0) > 0):
+            raise ValueError(
+                "pp training takes a plain TransformerLM (tp_size=1, "
+                "non-ring attention, no MoE)"
+            )
+        mesh = make_mesh({"pp": pp, "dp": dp})
+
+        # Checkpoints store the PLAIN module layout for params AND the
+        # optimizer state's param-mirror subtrees (mu/nu/trace/... embed a
+        # params-shaped tree each), so a pp checkpoint restores on any
+        # other LMTrainer mesh and vice versa.
+        def _map_mirrors(opt_state, convert, mirror_keys):
+            def is_mirror(x):
+                return isinstance(x, dict) and set(x) == mirror_keys
+
+            return jax.tree.map(
+                lambda x: convert(x) if is_mirror(x) else x,
+                opt_state, is_leaf=is_mirror,
+            )
+
+        def opt_state_to_plain(opt_state, L):
+            return _map_mirrors(
+                opt_state, lambda m: from_pipeline_params(m, L),
+                {"blocks", "rest"},
+            )
+
+        def opt_state_to_pipeline(opt_state, L):
+            return _map_mirrors(
+                opt_state, lambda m: to_pipeline_params(m, L), {"params"}
+            )
+
+        # device->host for pp-sharded trees: replicate on device first (an
+        # all-gather over the mesh) so np.asarray sees an addressable
+        # replica even when the pp axis spans processes
+        _replicate = jax.jit(
+            lambda t: t,
+            out_shardings=NamedSharding(mesh, P()),
+        )
+
+        def _gather_host(tree):
+            return jax.tree.map(np.asarray, _replicate(tree))
+
+        tokens = np.asarray(dataset.column(self.tokens_col))
+        if tokens.ndim != 2:
+            raise ValueError(
+                f"'{self.tokens_col}' must be [N, T] int token ids, got "
+                f"shape {tokens.shape}"
+            )
+        self._init_params(tokens, sp=1)
+        L = self.model.num_layers
+
+        M = self.microbatches or 4 * pp
+        B = self.batch_size
+        if B % M != 0:
+            raise ValueError(
+                f"batch_size={B} not divisible by microbatches={M}"
+            )
+        micro_B = B // M
+        if micro_B % dp != 0:
+            raise ValueError(
+                f"microbatch size {micro_B} (= batch_size/{M}) not "
+                f"divisible by dp={dp}"
+            )
+
+        optimizer = get_optimizer(self.worker_optimizer, self.learning_rate)
+        step = make_pp_lm_train_step(
+            self.model, optimizer, mesh, params_template=self.params
+        )
+
+        n = (len(tokens) // B) * B
+        if n == 0:
+            raise ValueError(
+                f"dataset of {len(tokens)} rows is smaller than "
+                f"batch_size={B}"
+            )
+        # [steps, M, micro_B, T] — one optimizer step per leading index
+        batches = tokens[:n].reshape(-1, M, micro_B,
+                                     tokens.shape[1]).astype(np.int32)
+
+        pp_params = to_pipeline_params(self.params, L)
+        opt_state = optimizer.init(pp_params)
+        start_epoch = 0
+        if self.checkpointer is not None:
+            plain_opt_template = jax.tree.map(
+                np.asarray, opt_state_to_plain(opt_state, L)
+            )
+            ck_step, state = self.checkpointer.restore(like={
+                "params": self.params, "opt_state": plain_opt_template,
+                "extra": {"epoch": 0},
+            })
+            if state is not None:
+                pp_params = to_pipeline_params(state["params"], L)
+                if state["opt_state"]:
+                    opt_state = opt_state_to_pipeline(state["opt_state"], L)
+                start_epoch = int(state["extra"].get("epoch", ck_step))
+
+        feed_sharding = NamedSharding(mesh, P(None, "dp", None))
+
+        def put_feed(arr):
+            if jax.process_count() > 1:
+                return jax.make_array_from_process_local_data(
+                    feed_sharding, arr
+                )
+            return jax.device_put(arr, feed_sharding)
+
+        staged = batches.nbytes <= self.stage_limit_bytes
+        feed = [put_feed(b) for b in batches] if staged else list(batches)
+        history: History = []
+        for epoch in range(start_epoch, self.num_epoch):
+            epoch_losses = []
+            for fb in feed:
+                if not staged:
+                    fb = put_feed(fb)
+                pp_params, opt_state, loss = step(pp_params, opt_state, fb)
+                epoch_losses.append(loss)
+            for loss in epoch_losses:
+                row = {"loss": float(np.asarray(loss))}
+                history.append(row)
+                if self.metrics_writer is not None:
+                    self.metrics_writer.log(
+                        step=len(history), samples=B * tokens.shape[1],
+                        **row,
+                    )
+            if self.checkpointer is not None:
+                final = epoch + 1 == self.num_epoch
+                # gate the (params-sized, cross-mesh) gather on the save
+                # cadence — maybe_save would skip the step anyway
+                if final or (epoch + 1) % self.checkpointer.every_steps == 0:
+                    self.checkpointer.maybe_save(
+                        epoch + 1,
+                        from_pipeline_params(_gather_host(pp_params), L),
+                        opt_state_to_plain(_gather_host(opt_state), L),
+                        extra={"epoch": epoch + 1},
+                        force=final,
+                    )
+        self.params = from_pipeline_params(_gather_host(pp_params), L)
         self.history = history
         self.executor_histories = [history]
         return Model(self.model, self.params)

@@ -465,6 +465,23 @@ def test_write_shards_same_kind_dtype_cast_to_meta(tmp_path):
     np.testing.assert_array_equal(loaded[4:], np.full((4, 2), 2.0, np.float32))
 
 
+def test_write_shards_mismatched_column_set_raises(tmp_path):
+    """A partition with extra or missing columns must raise, not silently
+    drop the extras / KeyError on the missing ones."""
+    extra = [
+        {"x": np.ones((4, 3), np.float32)},
+        {"x": np.ones((4, 3), np.float32), "y": np.zeros(4, np.int32)},
+    ]
+    with pytest.raises(ValueError, match="columns"):
+        write_shards(PartitionedDataset(extra), str(tmp_path / "bad1"))
+    missing = [
+        {"x": np.ones((4, 3), np.float32), "y": np.zeros(4, np.int32)},
+        {"x": np.ones((4, 3), np.float32)},
+    ]
+    with pytest.raises(ValueError, match="columns"):
+        write_shards(PartitionedDataset(missing), str(tmp_path / "bad2"))
+
+
 def test_write_shards_mismatched_row_shape_raises(tmp_path):
     parts = [
         {"x": np.ones((4, 3), np.float32)},
