@@ -70,8 +70,9 @@ def _peak_flops():
 def lm_bench():
     """Flagship TransformerLM training throughput + MFU on one chip.
 
-    Returns extra JSON fields (or {} when the step doesn't fit/compile,
-    e.g. on a small-RAM CPU host)."""
+    Returns extra JSON fields, or ``{"lm_error": ...}`` when the step
+    doesn't fit/compile (e.g. on a small-RAM CPU host). A NaN loss or a
+    code bug still raises."""
     import optax
 
     from distkeras_tpu.models import get_model

@@ -284,11 +284,14 @@ class ParameterServerService:
             conn.close()
 
     def _dispatch(self, conn: socket.socket, op, msg: dict):
+        # the PS center is device-resident; this service is the host
+        # boundary, so every outgoing tree crosses through pull_host /
+        # _to_host before serialization
         if op == "pull":
-            send_msg(conn, {"value": self.ps.pull()})
+            send_msg(conn, {"value": self.ps.pull_host()})
         elif op == "pull_with_clock":
             value, clock = self.ps.pull_with_clock()
-            send_msg(conn, {"value": value, "clock": clock})
+            send_msg(conn, {"value": _to_host(value), "clock": clock})
         elif op == "commit":
             self.ps.commit(
                 msg["delta"], worker=int(msg.get("worker", 0)),
@@ -299,7 +302,7 @@ class ParameterServerService:
             center = self.ps.commit_and_wait(
                 msg["params"], worker=int(msg.get("worker", 0))
             )
-            send_msg(conn, {"value": center})
+            send_msg(conn, {"value": _to_host(center)})
         elif op == "leave":
             wid = int(msg.get("worker", 0))
             if wid < 0:
@@ -397,22 +400,27 @@ class RemoteParameterServer:
     def stop(self):
         pass
 
-    def pull(self):
-        return self._call({"op": "pull"})["value"]
+    def pull(self, device=None):
+        value = self._call({"op": "pull"})["value"]
+        return jax.device_put(value, device) if device is not None else value
 
-    def pull_with_clock(self):
+    def pull_with_clock(self, device=None):
         r = self._call({"op": "pull_with_clock"})
-        return r["value"], int(r["clock"])
+        value = r["value"]
+        if device is not None:
+            value = jax.device_put(value, device)
+        return value, int(r["clock"])
 
     def commit(self, delta, worker: int = 0, worker_clock: int = 0):
         self._call({"op": "commit", "delta": _to_host(delta),
                     "worker": worker, "clock": worker_clock})
 
-    def commit_and_wait(self, params, worker: int = 0):
-        return self._call(
+    def commit_and_wait(self, params, worker: int = 0, device=None):
+        value = self._call(
             {"op": "commit_and_wait", "params": _to_host(params),
              "worker": worker}
         )["value"]
+        return jax.device_put(value, device) if device is not None else value
 
     def leave(self, worker: int = 0):
         try:

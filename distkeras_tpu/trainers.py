@@ -1094,7 +1094,11 @@ class LMTrainer(Trainer):
     token ids ``[N, T]``; each step consumes a ``[batch_size, T]`` global
     batch sharded batch-over-dp, sequence-over-sp. The loss is the global
     mean next-token cross-entropy (``loss``/``metrics``/``label_col``
-    kwargs are ignored — an LM supervises itself).
+    kwargs are ignored — an LM supervises itself). A
+    :class:`~distkeras_tpu.data.shard_io.ShardedDataset` streams from
+    disk shard by shard (peak host memory O(shard), identical
+    trajectory to the in-memory path; ``shuffle=True`` becomes the
+    two-level per-epoch reshuffle).
 
     Multi-process (pod) runs: with ``jax.distributed`` up (see
     :mod:`distkeras_tpu.runtime`) the mesh spans all processes; each
@@ -1120,6 +1124,96 @@ class LMTrainer(Trainer):
                 "axes={'pp': ..., 'dp': ...} (or drop microbatches)"
             )
 
+    def _coerce_dataset(self, dataset):
+        return dataset  # both LM paths stream ShardedDatasets natively
+
+    # token batches per stacked dispatch on the disk-streaming path
+    STREAM_GROUP = 16
+
+    def _maybe_materialize(self, dataset):
+        """(dataset, sharded): a sharded corpus that fits the staging
+        budget is materialized so it gets the stage-once-on-device path
+        (re-reading disk + re-uploading per epoch would be pure waste);
+        bigger ones stream. Multi-process runs always stream — after a
+        load() every process would hold ALL shards and silently feed
+        duplicate rows."""
+        from distkeras_tpu.data.shard_io import ShardedDataset
+
+        if not isinstance(dataset, ShardedDataset):
+            return dataset, False
+        T = self._sharded_seq_len(dataset)
+        itemsize = np.dtype(
+            dataset.meta["columns"][self.tokens_col]["dtype"]
+        ).itemsize
+        small = dataset.num_rows * T * itemsize <= self.stage_limit_bytes
+        if small and jax.process_count() == 1:
+            return dataset.load(), False
+        return dataset, True
+
+    def _sharded_seq_len(self, sds) -> int:
+        """Sequence length from shard metadata (no IO)."""
+        if self.tokens_col not in sds.columns:
+            raise ValueError(
+                f"shard directory has no '{self.tokens_col}' column; "
+                f"available: {sds.columns}"
+            )
+        _, row_shape = sds._col_info(self.tokens_col)
+        if len(row_shape) != 1:
+            raise ValueError(
+                f"'{self.tokens_col}' must be [N, T] token ids; shard "
+                f"rows have shape {row_shape}"
+            )
+        return row_shape[0]
+
+    def _shard_slice(self, sds, rows_per_step: int):
+        """(shard indices, per-epoch step cap) for THIS process.
+
+        Multi-process runs stream disjoint shard strides (the same
+        convention as DataParallelTrainer) and truncate every process to
+        the smallest per-process step count so the collective step can't
+        desynchronize; single-process runs stream everything uncapped.
+
+        The cap divides by a flat ``rows_per_step`` because LMTrainer's
+        ``batch_size`` counts each process's OWN contribution (class
+        docstring) — unlike DataParallelTrainer, whose batch_size is
+        per-device and therefore scales by each process's device count
+        (``feed_of[p]`` there, trainers.py · DataParallelTrainer._train).
+        """
+        if jax.process_count() <= 1:
+            return None, None
+        pi, pc = jax.process_index(), jax.process_count()
+        if sds.num_shards < pc:
+            raise ValueError(
+                f"sharded multi-process LM training needs >= {pc} shards "
+                f"(one per process); directory has {sds.num_shards}"
+            )
+        cap = min(
+            sum(sds.shard_rows[s] for s in range(p, sds.num_shards, pc))
+            // rows_per_step
+            for p in range(pc)
+        )
+        if cap == 0:
+            raise ValueError(
+                "some process's shard slice holds fewer rows than one "
+                f"step's batch ({rows_per_step}) — use smaller batches "
+                "or rebalance the shard directory"
+            )
+        return list(range(pi, sds.num_shards, pc)), cap
+
+    def _stream_steps(self, sds, rows_per_step: int, shuffle: bool,
+                      epoch: int, my_shards, cap):
+        """Yield [rows_per_step, T] int32 arrays for one epoch, reading
+        shard by shard (peak host memory O(shard), not O(corpus)); the
+        two-level reshuffle uses a per-epoch seed."""
+        seed = self.seed + epoch if shuffle else None
+        n = 0
+        for b in sds.batches(rows_per_step, shuffle_seed=seed,
+                             shards=my_shards):
+            if cap is not None and n >= cap:
+                break
+            n += 1
+            yield np.ascontiguousarray(b[self.tokens_col], np.int32)
+
     def _init_params(self, tokens: np.ndarray, sp: int):
         """Full-size host init via a standard-attention twin (ring
         attention only traces inside shard_map with the axis bound); the
@@ -1144,15 +1238,20 @@ class LMTrainer(Trainer):
         return self.params
 
     def _train(self, dataset: PartitionedDataset, shuffle: bool = False) -> Model:
+        from distkeras_tpu.data.shard_io import ShardedDataset
         from distkeras_tpu.parallel.mesh import make_mesh
         from distkeras_tpu.parallel.spmd import make_lm_train_step
         from jax.sharding import NamedSharding
 
-        if shuffle:
+        # in-memory datasets (and small sharded corpora, which materialize)
+        # shuffle once up front; streaming ShardedDatasets get the
+        # two-level per-epoch reshuffle inside the feed instead
+        dataset, sharded = self._maybe_materialize(dataset)
+        if shuffle and not sharded:
             dataset = dataset.shuffle(seed=self.seed)
         axes = dict(self.axes) if self.axes else {"dp": len(jax.devices())}
         if axes.get("pp", 1) > 1:
-            return self._train_pp(dataset)
+            return self._train_pp(dataset, shuffle)
         # an MoE model (ep_size > 1) trains on a (dp, ep) mesh via the
         # MoE step; everything else on dp x sp (x tp) via the LM step
         moe = getattr(self.model, "ep_size", 1) > 1
@@ -1191,17 +1290,43 @@ class LMTrainer(Trainer):
                     f"mesh tp size {tp}"
                 )
 
-        tokens = np.asarray(dataset.column(self.tokens_col))
-        if tokens.ndim != 2:
+        if sharded:
+            # disk-resident corpus: stream shard by shard (VERDICT r2 #3 —
+            # the long-context path is the one most likely to meet a
+            # corpus bigger than host RAM)
+            T = self._sharded_seq_len(dataset)
+            n_rows = dataset.num_rows
+            if jax.process_count() > 1 and (sp > 1 or tp > 1):
+                # each process streams a disjoint shard stride, which is
+                # only sound when processes are disjoint along dp (they
+                # hold different batch rows). With sp/tp spanning
+                # processes the replicas must feed IDENTICAL rows —
+                # make_array_from_process_local_data does not check this,
+                # so it would silently train on inconsistent data.
+                raise NotImplementedError(
+                    "multi-process disk streaming supports dp (x ep) "
+                    "meshes only; with sp/tp > 1 load() the corpus or "
+                    "train single-process per host"
+                )
+        else:
+            tokens = np.asarray(dataset.column(self.tokens_col))
+            if tokens.ndim != 2:
+                raise ValueError(
+                    f"'{self.tokens_col}' must be [N, T] int token ids, "
+                    f"got shape {tokens.shape}"
+                )
+            T = tokens.shape[1]
+            n_rows = len(tokens)
+        if T % max(sp, 1) != 0:
             raise ValueError(
-                f"'{self.tokens_col}' must be [N, T] int token ids, got "
-                f"shape {tokens.shape}"
+                f"sequence length {T} not divisible by sp={sp}"
             )
-        if tokens.shape[1] % max(sp, 1) != 0:
-            raise ValueError(
-                f"sequence length {tokens.shape[1]} not divisible by sp={sp}"
-            )
-        self._init_params(tokens, sp)
+        if sharded:
+            first = dataset.read_shard(0)[self.tokens_col]
+            self._init_params(np.ascontiguousarray(first[:1], np.int32), sp)
+            del first
+        else:
+            self._init_params(tokens, sp)
 
         optimizer = get_optimizer(self.worker_optimizer, self.learning_rate)
         if moe:
@@ -1220,13 +1345,13 @@ class LMTrainer(Trainer):
             )
 
         B = self.batch_size
-        n = (len(tokens) // B) * B
-        if n == 0:
+        if n_rows < B:
             raise ValueError(
-                f"dataset of {len(tokens)} rows is smaller than "
-                f"batch_size={B}"
+                f"dataset of {n_rows} rows is smaller than batch_size={B}"
             )
-        batches = tokens[:n].reshape(-1, B, tokens.shape[1]).astype(np.int32)
+        if not sharded:
+            n = (n_rows // B) * B
+            batches = tokens[:n].reshape(-1, B, T).astype(np.int32)
 
         params = self.params
         opt_state = optimizer.init(params)
@@ -1241,23 +1366,15 @@ class LMTrainer(Trainer):
                 opt_state = state["opt_state"] or opt_state
                 start_epoch = int(state["extra"].get("epoch", ck_step))
 
+        # windowed steps: [W, B, T] stacked batches, one device dispatch
+        # per group — the scan runs the W optimizer steps on-device
         if moe:
-            # windowed MoE step: [W, B, T] stacked batches, sharded dp x ep
             feed_sharding = NamedSharding(mesh, P(None, ("dp", "ep")))
-            W = 16
-            feed = ([batches] if batches.nbytes <= self.stage_limit_bytes
-                    else [batches[i:i + W]
-                          for i in range(0, len(batches), W)])
         else:
-            # windowed LM step: the whole epoch (or W-batch groups) is ONE
-            # device dispatch — the scan runs the optimizer steps on-device
             feed_sharding = NamedSharding(
                 mesh, P(None, "dp", "sp") if sp > 1 else P(None, "dp")
             )
-            W = 16
-            feed = ([batches] if batches.nbytes <= self.stage_limit_bytes
-                    else [batches[i:i + W]
-                          for i in range(0, len(batches), W)])
+        W = self.STREAM_GROUP
 
         # multi-process pod runs: this process feeds its devices' share of
         # every global token batch (same contract as DataParallelTrainer)
@@ -1268,17 +1385,35 @@ class LMTrainer(Trainer):
                 )
             return jax.device_put(arr, feed_sharding)
 
-        # stage everything once when it fits the budget — zero re-upload
-        # across epochs
-        staged = batches.nbytes <= self.stage_limit_bytes
-        if staged:
-            feed = [put_feed(f) for f in feed]
+        staged = False
+        if sharded:
+            my_shards, step_cap = self._shard_slice(dataset, B)
+
+            def epoch_groups(epoch):
+                group = []
+                for tb in self._stream_steps(dataset, B, shuffle, epoch,
+                                             my_shards, step_cap):
+                    group.append(tb)
+                    if len(group) == W:
+                        yield np.stack(group)
+                        group = []
+                if group:
+                    yield np.stack(group)
+        else:
+            # stage everything once when it fits the budget — zero
+            # re-upload across epochs
+            staged = batches.nbytes <= self.stage_limit_bytes
+            if staged:
+                feed = [put_feed(batches)]
+            else:
+                feed = [batches[i:i + W]
+                        for i in range(0, len(batches), W)]
         history: History = []
         for epoch in range(start_epoch, self.num_epoch):
             # keep losses on-device until the epoch ends so dispatches
             # pipeline (no per-step host sync)
             epoch_losses = []
-            for fb in feed:
+            for fb in (epoch_groups(epoch) if sharded else feed):
                 if not staged:
                     fb = put_feed(fb)
                 params, opt_state, losses = step(params, opt_state, fb)
@@ -1289,8 +1424,7 @@ class LMTrainer(Trainer):
                     history.append(row)
                     if self.metrics_writer is not None:
                         self.metrics_writer.log(
-                            step=len(history), samples=B * tokens.shape[1],
-                            **row,
+                            step=len(history), samples=B * T, **row,
                         )
             if self.checkpointer is not None:
                 self.checkpointer.maybe_save(
@@ -1304,7 +1438,7 @@ class LMTrainer(Trainer):
         self.executor_histories = [history]
         return Model(self.model, self.params)
 
-    def _train_pp(self, dataset: PartitionedDataset) -> Model:
+    def _train_pp(self, dataset, shuffle: bool = False) -> Model:
         """Pipeline-parallel training: ``axes={"pp": ..., "dp": ...}``.
 
         The layer stack is split into ``pp`` contiguous stages
@@ -1341,7 +1475,18 @@ class LMTrainer(Trainer):
                 "pp training takes a plain TransformerLM (tp_size=1, "
                 "non-ring attention, no MoE)"
             )
-        mesh = make_mesh({"pp": pp, "dp": dp})
+        # dp MAJOR, pp minor: multi-process meshes then split along dp, so
+        # each process holds complete pipelines and feeds only its own
+        # batch rows (pp-major would make processes replicas that must
+        # feed identical data — unchecked, and silently wrong). Minor-axis
+        # pp also keeps stage neighbors adjacent for the per-tick ppermute.
+        if jax.process_count() > 1 and dp % jax.process_count() != 0:
+            raise NotImplementedError(
+                f"multi-process pp training needs dp ({dp}) divisible by "
+                f"the process count ({jax.process_count()}) so every "
+                "process holds complete pipelines and disjoint batch rows"
+            )
+        mesh = make_mesh({"dp": dp, "pp": pp})
 
         # Checkpoints store the PLAIN module layout for params AND the
         # optimizer state's param-mirror subtrees (mu/nu/trace/... embed a
@@ -1378,13 +1523,25 @@ class LMTrainer(Trainer):
         def _gather_host(tree):
             return jax.tree.map(np.asarray, _replicate(tree))
 
-        tokens = np.asarray(dataset.column(self.tokens_col))
-        if tokens.ndim != 2:
-            raise ValueError(
-                f"'{self.tokens_col}' must be [N, T] int token ids, got "
-                f"shape {tokens.shape}"
-            )
-        self._init_params(tokens, sp=1)
+        from distkeras_tpu.data.shard_io import ShardedDataset
+
+        sharded = isinstance(dataset, ShardedDataset)
+        if sharded:
+            T = self._sharded_seq_len(dataset)
+            n_rows = dataset.num_rows
+            first = dataset.read_shard(0)[self.tokens_col]
+            self._init_params(np.ascontiguousarray(first[:1], np.int32), 1)
+            del first
+        else:
+            tokens = np.asarray(dataset.column(self.tokens_col))
+            if tokens.ndim != 2:
+                raise ValueError(
+                    f"'{self.tokens_col}' must be [N, T] int token ids, "
+                    f"got shape {tokens.shape}"
+                )
+            T = tokens.shape[1]
+            n_rows = len(tokens)
+            self._init_params(tokens, sp=1)
         L = self.model.num_layers
 
         M = self.microbatches or 4 * pp
@@ -1405,15 +1562,14 @@ class LMTrainer(Trainer):
             self.model, optimizer, mesh, params_template=self.params
         )
 
-        n = (len(tokens) // B) * B
-        if n == 0:
+        if n_rows < B:
             raise ValueError(
-                f"dataset of {len(tokens)} rows is smaller than "
-                f"batch_size={B}"
+                f"dataset of {n_rows} rows is smaller than batch_size={B}"
             )
-        # [steps, M, micro_B, T] — one optimizer step per leading index
-        batches = tokens[:n].reshape(-1, M, micro_B,
-                                     tokens.shape[1]).astype(np.int32)
+        if not sharded:
+            n = (n_rows // B) * B
+            # [steps, M, micro_B, T] — one optimizer step per leading index
+            batches = tokens[:n].reshape(-1, M, micro_B, T).astype(np.int32)
 
         pp_params = to_pipeline_params(self.params, L)
         opt_state = optimizer.init(pp_params)
@@ -1441,12 +1597,21 @@ class LMTrainer(Trainer):
                 )
             return jax.device_put(arr, feed_sharding)
 
-        staged = batches.nbytes <= self.stage_limit_bytes
-        feed = [put_feed(b) for b in batches] if staged else list(batches)
+        staged = False
+        if sharded:
+            my_shards, step_cap = self._shard_slice(dataset, B)
+
+            def epoch_steps(epoch):
+                for tb in self._stream_steps(dataset, B, shuffle, epoch,
+                                             my_shards, step_cap):
+                    yield tb.reshape(M, micro_B, T)
+        else:
+            staged = batches.nbytes <= self.stage_limit_bytes
+            feed = [put_feed(b) for b in batches] if staged else list(batches)
         history: History = []
         for epoch in range(start_epoch, self.num_epoch):
             epoch_losses = []
-            for fb in feed:
+            for fb in (epoch_steps(epoch) if sharded else feed):
                 if not staged:
                     fb = put_feed(fb)
                 pp_params, opt_state, loss = step(pp_params, opt_state, fb)
@@ -1456,8 +1621,7 @@ class LMTrainer(Trainer):
                 history.append(row)
                 if self.metrics_writer is not None:
                     self.metrics_writer.log(
-                        step=len(history), samples=B * tokens.shape[1],
-                        **row,
+                        step=len(history), samples=B * T, **row,
                     )
             if self.checkpointer is not None:
                 final = epoch + 1 == self.num_epoch

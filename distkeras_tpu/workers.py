@@ -330,9 +330,29 @@ class WindowedWorker(Worker):
 
     # -- center exchange hooks ---------------------------------------------
 
+    def _ps_takes_device(self, fn) -> bool:
+        """Whether a PS method accepts the ``device=`` kwarg — probed from
+        the signature, never by a trial call: these calls are side-effectful
+        (a commit_and_wait retried on TypeError would contribute to the
+        round barrier twice)."""
+        import inspect
+
+        try:
+            return "device" in inspect.signature(fn).parameters
+        except (TypeError, ValueError):
+            return False
+
+    def _pull(self, ps):
+        """Pull the center onto THIS worker's device. The in-process PS
+        transfers device-to-device (the center is device-resident); the
+        remote proxy returns host arrays, which ``_put`` uploads."""
+        if self._ps_takes_device(ps.pull):
+            return self._put(ps.pull(device=self.device))
+        return self._put(ps.pull())
+
     def on_start(self, index: int, ps):
         """Initial pull (reference · NetworkWorker: connect + first pull)."""
-        self.params = self._put(ps.pull())
+        self.params = self._pull(ps)
         self.last_pulled = self.params
 
     def on_round(self, index: int, ps):
@@ -396,7 +416,7 @@ class DOWNPOURWorker(WindowedWorker):
         self.worker_clock += 1
         # note: worker optimizer state persists across pulls, matching the
         # reference where set_weights() does not reset the Keras optimizer
-        self.params = self._put(ps.pull())
+        self.params = self._pull(ps)
         self.last_pulled = self.params
 
 
@@ -409,16 +429,21 @@ class DynSGDWorker(WindowedWorker):
     """Delta push tagged with the worker's clock at last pull
     (reference: distkeras/workers.py · DynSGDWorker)."""
 
+    def _pull_with_clock(self, ps):
+        if self._ps_takes_device(ps.pull_with_clock):
+            params, clock = ps.pull_with_clock(device=self.device)
+        else:
+            params, clock = ps.pull_with_clock()
+        return self._put(params), clock
+
     def on_start(self, index: int, ps):
-        params, self.worker_clock = ps.pull_with_clock()
-        self.params = self._put(params)
+        self.params, self.worker_clock = self._pull_with_clock(ps)
         self.last_pulled = self.params
 
     def on_round(self, index: int, ps):
         delta = rules.downpour_delta(self.params, self.last_pulled)
         ps.commit(delta, worker=index, worker_clock=self.worker_clock)
-        params, self.worker_clock = ps.pull_with_clock()
-        self.params = self._put(params)
+        self.params, self.worker_clock = self._pull_with_clock(ps)
         self.last_pulled = self.params
 
 
@@ -436,7 +461,7 @@ class AEASGDWorker(WindowedWorker):
         self.alpha = elastic_lr * rho
 
     def on_round(self, index: int, ps):
-        center = self._put(ps.pull())
+        center = self._pull(ps)
         diff = rules.elastic_difference(self.alpha, self.params, center)
         self.params = rules.tree_sub(self.params, diff)
         ps.commit(diff, worker=index, worker_clock=self.worker_clock)
@@ -463,5 +488,11 @@ class EASGDWorker(WindowedWorker):
 
     def on_round(self, index: int, ps):
         # commit blocks until every worker has contributed to the round
-        center = self._put(ps.commit_and_wait(self.params, worker=index))
+        if self._ps_takes_device(ps.commit_and_wait):
+            center = ps.commit_and_wait(
+                self.params, worker=index, device=self.device
+            )
+        else:
+            center = ps.commit_and_wait(self.params, worker=index)
+        center = self._put(center)
         self.params = rules.easgd_worker_update(self.params, center, self.alpha)
