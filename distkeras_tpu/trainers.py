@@ -290,8 +290,19 @@ class _StackedModelTrainer(Trainer):
             ys.append(yb)
         # models advance in lockstep inside one program: truncate to the
         # shortest partition's batch count (repartition splits near-equally,
-        # so at most one trailing batch per model is dropped)
+        # so at most one trailing batch per model is dropped — loudly)
         nb = min(len(x) for x in xs)
+        dropped = sum(len(x) - nb for x in xs)
+        if dropped:
+            import warnings
+
+            warnings.warn(
+                f"ensemble lock-step truncated {dropped} trailing "
+                f"batch(es) across {k} models (shortest partition has "
+                f"{nb}); pick batch_size/partitions that divide evenly "
+                "to keep them",
+                RuntimeWarning,
+            )
         xb = np.stack([x[:nb] for x in xs])
         yb = np.stack([y[:nb] for y in ys])
 
@@ -811,15 +822,34 @@ class SynchronousDistributedTrainer(DistributedTrainer):
 
 class EASGD(SynchronousDistributedTrainer):
     """Synchronous elastic averaging (reference: trainers.py · EASGD):
-    every round is a full barrier across workers."""
+    every round is a full barrier across workers.
+
+    Two execution engines for the same math (SURVEY.md §2: "sync maps
+    naturally to psum"):
+
+    - default: worker threads + the host barrier PS
+      (:class:`~distkeras_tpu.parameter_servers.EASGDParameterServer`) —
+      tolerates unequal partitions and worker crashes (barrier shrink);
+    - ``spmd=True``: every worker is a mesh device in lock-step — worker
+      params/opt-state live sharded over ``dp``, the center is replicated,
+      and each round is one
+      :func:`distkeras_tpu.ops.rules.allreduce_easgd_round` inside the
+      jitted ``shard_map`` window, so a whole window (W local steps +
+      elastic round) is a single device dispatch with the round riding
+      ICI. Equivalent trajectories under identical data order (tested).
+      Single-process (one mesh per host); checkpoints carry the stacked
+      worker params + moments, so resume is exact. Multi-host elastic
+      averaging uses the host-barrier engine over the DCN service.
+    """
 
     WORKER_CLS = workers_mod.EASGDWorker
 
     def __init__(self, *args, rho: float = 5.0, elastic_lr: float = 0.01,
-                 **kwargs):
+                 spmd: bool = False, **kwargs):
         super().__init__(*args, **kwargs)
         self.rho = rho
         self.elastic_lr = elastic_lr
+        self.spmd = spmd
 
     def extra_worker_kwargs(self):
         return dict(rho=self.rho, elastic_lr=self.elastic_lr)
@@ -829,6 +859,200 @@ class EASGD(SynchronousDistributedTrainer):
             self.params, getattr(self, "_ps_num_workers", self.num_workers),
             rho=self.rho, elastic_lr=self.elastic_lr,
         )
+
+    def _train(self, dataset, shuffle: bool = False) -> Model:
+        if self.spmd:
+            return self._train_spmd(dataset, shuffle)
+        return super()._train(dataset, shuffle)
+
+    def _train_spmd(self, dataset: PartitionedDataset,
+                    shuffle: bool = False) -> Model:
+        import warnings
+
+        from distkeras_tpu.parallel.mesh import default_mesh
+        from jax.sharding import NamedSharding
+
+        if jax.process_count() > 1:
+            raise NotImplementedError(
+                "EASGD(spmd=True) is single-process (one mesh per host); "
+                "multi-host elastic averaging uses the host-barrier "
+                "engine over the DCN PS service (spmd=False)"
+            )
+        if shuffle:
+            dataset = dataset.shuffle(seed=self.seed)
+        self.ensure_params(dataset)
+        mesh = default_mesh(self.num_workers)
+        n_dev = mesh.devices.size
+        alpha = self.elastic_lr * self.rho
+
+        optimizer = get_optimizer(self.worker_optimizer, self.learning_rate)
+        loss_fn = get_loss(self.loss)
+        metric_fns = resolve_metrics(self.metrics)
+        apply_fn = self.model.apply
+
+        # worker i's partition becomes device i's batch stream: batch each
+        # partition, truncate to the shortest (lock-step needs equal step
+        # counts; the host-barrier engine instead shrinks its barrier), and
+        # interleave so global batch g carries worker i's rows at slice i
+        parts = dataset.repartition(n_dev)
+        per_worker = [
+            workers_mod.batch_partition(
+                parts.partition(i), self.features_col, self.label_col,
+                self.batch_size,
+            )
+            for i in range(n_dev)
+        ]
+        n_b = min(len(xb) for xb, _ in per_worker)
+        dropped = sum(len(xb) - n_b for xb, _ in per_worker)
+        if dropped:
+            warnings.warn(
+                f"EASGD(spmd): lock-step truncated {dropped} batches "
+                f"across {n_dev} workers (shortest partition has "
+                f"{n_b}); repartition for equal sizes to keep them",
+                RuntimeWarning,
+            )
+        # [n_b, feed_dev*B, ...]: concat worker slices per global batch
+        xb = np.concatenate(
+            [xw[:n_b] for xw, _ in per_worker], axis=1
+        )
+        yb = np.concatenate(
+            [yw[:n_b] for _, yw in per_worker], axis=1
+        )
+
+        W = self.communication_window
+
+        def device_window(worker, opt_state, center, xs, ys):
+            # worker/opt_state arrive dp-sharded with a leading axis of 1
+            # (this device's slice); squeeze it for the step math
+            worker = jax.tree.map(lambda x: x[0], worker)
+            opt_state = jax.tree.map(lambda x: x[0], opt_state)
+
+            def one(carry, batch):
+                p, s = carry
+                x, y = batch
+
+                def objective(pp):
+                    logits = apply_fn(pp, x)
+                    return loss_fn(logits, y), logits
+
+                (loss, logits), grads = jax.value_and_grad(
+                    objective, has_aux=True)(p)
+                updates, s = optimizer.update(grads, s, p)
+                p = optax.apply_updates(p, updates)
+                out = {"loss": loss}
+                for name, fn in metric_fns:
+                    out[name] = fn(logits, y)
+                return (p, s), out
+
+            (worker, opt_state), ms = jax.lax.scan(
+                one, (worker, opt_state), (xs, ys)
+            )
+            worker, center = rules.allreduce_easgd_round(
+                worker, center, alpha, "dp"
+            )
+            # re-lead every per-device output so the dp out_spec stacks
+            # them back to [n_dev, ...] ([n_dev, W] for the metrics)
+            lead = jax.tree.map(lambda x: x[None], worker)
+            lead_s = jax.tree.map(lambda x: x[None], opt_state)
+            ms = jax.tree.map(lambda x: x[None], ms)
+            return lead, lead_s, center, ms
+
+        window_step = jax.jit(
+            shard_map(
+                device_window,
+                mesh=mesh,
+                in_specs=(P("dp"), P("dp"), P(), P(None, "dp"), P(None, "dp")),
+                out_specs=(P("dp"), P("dp"), P(), P("dp")),
+            )
+        )
+
+        center = self.params
+        # every worker starts from the center (reference: workers pull the
+        # initial center before their first round)
+        worker = jax.tree.map(
+            lambda x: np.broadcast_to(np.asarray(x), (n_dev,) + x.shape),
+            center,
+        )
+        opt0 = optimizer.init(self.params)
+        opt_state = jax.tree.map(
+            lambda x: np.broadcast_to(np.asarray(x), (n_dev,) + np.shape(x)),
+            opt0,
+        )
+
+        # checkpoints carry center AND the stacked per-worker state (params
+        # + optimizer moments) so a resume is EXACT: restoring only the
+        # center would pair each worker's surviving momentum with params it
+        # was never computed for
+        start_epoch = 0
+        if self.checkpointer is not None:
+            ck_step, state = self.checkpointer.restore(like={
+                "params": center,
+                "opt_state": {
+                    "worker": jax.tree.map(np.asarray, worker),
+                    "opt": jax.tree.map(np.asarray, opt_state),
+                },
+                "extra": {"epoch": 0},
+            })
+            if state is not None:
+                center = state["params"]
+                start_epoch = int(state["extra"].get("epoch", ck_step))
+                if state["opt_state"]:
+                    worker = state["opt_state"]["worker"]
+                    opt_state = state["opt_state"]["opt"]
+
+        batch_sharding = NamedSharding(mesh, P(None, "dp"))
+
+        def put_feed(arr):
+            return jax.device_put(arr, batch_sharding)
+
+        # windows: full W-batch groups + one tail group (its own compile)
+        groups = [(s, min(s + W, n_b)) for s in range(0, n_b, W)]
+        staged = xb.nbytes + yb.nbytes <= self.stage_limit_bytes
+        if staged:
+            xb_d, yb_d = put_feed(xb), put_feed(yb)
+
+        history_per_worker: List[History] = [[] for _ in range(n_dev)]
+        for epoch in range(start_epoch, self.num_epoch):
+            epoch_ms = []
+            for s, e in groups:
+                if staged:
+                    xw, yw = xb_d[s:e], yb_d[s:e]
+                else:
+                    xw, yw = put_feed(xb[s:e]), put_feed(yb[s:e])
+                worker, opt_state, center, ms = window_step(
+                    worker, opt_state, center, xw, yw
+                )
+                epoch_ms.append(ms)
+            for ms in epoch_ms:
+                ms = {k: np.asarray(v) for k, v in ms.items()}
+                steps = next(iter(ms.values())).shape[1]
+                for w in range(n_dev):
+                    rows = [
+                        {k: float(v[w, t]) for k, v in ms.items()}
+                        for t in range(steps)
+                    ]
+                    history_per_worker[w].extend(rows)
+                    if self.metrics_writer is not None:
+                        base = len(history_per_worker[w]) - steps
+                        for t, r in enumerate(rows):
+                            self.metrics_writer.log(
+                                step=base + t + 1, worker=w,
+                                samples=self.batch_size, **r,
+                            )
+            if self.checkpointer is not None:
+                self.checkpointer.maybe_save(
+                    epoch + 1, jax.tree.map(np.asarray, center),
+                    {
+                        "worker": jax.tree.map(np.asarray, worker),
+                        "opt": jax.tree.map(np.asarray, opt_state),
+                    },
+                    extra={"epoch": epoch + 1},
+                    force=(epoch + 1 == self.num_epoch),
+                )
+        self.params = jax.tree.map(np.asarray, center)
+        self.executor_histories = history_per_worker
+        self.history = history_per_worker[0]
+        return Model(self.model, self.params)
 
 
 class DataParallelTrainer(Trainer):

