@@ -13,4 +13,6 @@ from distkeras_tpu.utils.keras_import import (  # noqa: F401
     from_keras,
     from_keras_config,
     keras_available,
+    to_keras,
+    to_keras_config,
 )

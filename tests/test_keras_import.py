@@ -282,3 +282,165 @@ def test_bare_layer_list_config_imports():
     spec_dict = keras_config_to_spec({"layers": layers})
     assert spec_list == spec_dict
     assert spec_list[0][0] == "dense"
+
+
+# -- round 3: functional chains, train_mode, export ------------------------
+
+
+def func_chain():
+    inp = keras.Input((16,))
+    h = keras.layers.Dense(32, activation="relu", name="d1")(inp)
+    h = keras.layers.BatchNormalization(name="bn")(h)
+    h = keras.layers.Dense(4, activation="softmax", name="d2")(h)
+    return keras.Model(inp, h)
+
+
+def test_functional_linear_chain_imports():
+    km = func_chain()
+    # give BN non-trivial moving stats
+    x_warm = np.random.default_rng(5).normal(size=(64, 16)).astype(np.float32)
+    km(x_warm, training=True)
+    model = from_keras(km)
+    x = np.random.default_rng(6).normal(size=(16, 16)).astype(np.float32)
+    np.testing.assert_allclose(
+        model.predict(x), km.predict(x, verbose=0), rtol=1e-4, atol=1e-5
+    )
+
+
+def test_functional_branch_raises():
+    inp = keras.Input((8,))
+    a = keras.layers.Dense(8, name="a")(inp)
+    b = keras.layers.Dense(8, name="b")(inp)
+    out = keras.layers.Add(name="add")([a, b])
+    km = keras.Model(inp, out)
+    with pytest.raises(ValueError, match="linear chain"):
+        from_keras(km)
+
+
+def test_train_mode_batchnorm_matches_keras_training_step():
+    """train=True BN uses batch statistics and updates the moving stats
+    with Keras' momentum rule; inference stays running-stat exact."""
+    km = keras.Sequential([
+        keras.layers.Input((12,)),
+        keras.layers.BatchNormalization(momentum=0.9),
+    ])
+    x_warm = np.random.default_rng(7).normal(
+        size=(64, 12)).astype(np.float32) * 2 + 1
+    km(x_warm, training=True)
+
+    model = from_keras(km, train_mode=True)
+    x = np.random.default_rng(8).normal(size=(32, 12)).astype(np.float32)
+
+    # inference: running-average path, exact vs keras
+    np.testing.assert_allclose(
+        model.predict(x), km.predict(x, verbose=0), rtol=1e-4, atol=1e-5
+    )
+
+    # one training step: outputs are batch-normalized like keras', and
+    # the mutated batch_stats follow the same momentum update
+    y_native, mutated = model.module.apply(
+        model.params, x, train=True, mutable=["batch_stats"]
+    )
+    y_keras = np.asarray(km(x, training=True))
+    np.testing.assert_allclose(
+        np.asarray(y_native), y_keras, rtol=1e-3, atol=1e-4
+    )
+    k_mean, k_var = [np.asarray(w) for w in km.get_weights()[2:4]]
+    n_stats = mutated["batch_stats"]["layer_0"]
+    np.testing.assert_allclose(
+        np.asarray(n_stats["mean"]), k_mean, rtol=1e-3, atol=1e-4
+    )
+    np.testing.assert_allclose(
+        np.asarray(n_stats["var"]), k_var, rtol=2e-2, atol=1e-3
+    )
+
+
+def test_train_mode_dropout_is_stochastic():
+    import jax
+
+    km = seq_mlp()
+    model = from_keras(km, train_mode=True)
+    x = np.random.default_rng(9).normal(size=(64, 16)).astype(np.float32)
+    # inference: identical to the deterministic import
+    np.testing.assert_allclose(
+        model.predict(x), from_keras(km).predict(x), rtol=1e-6, atol=1e-7
+    )
+    y1 = model.module.apply(model.params, x, train=True,
+                            rngs={"dropout": jax.random.PRNGKey(0)})
+    y2 = model.module.apply(model.params, x, train=True,
+                            rngs={"dropout": jax.random.PRNGKey(1)})
+    assert not np.allclose(np.asarray(y1), np.asarray(y2))
+
+
+def test_export_round_trip_preserves_outputs():
+    """Keras -> native -> to_keras: predictions survive both hops,
+    including the folded-affine BN re-expansion."""
+    from distkeras_tpu.utils.keras_import import to_keras
+
+    km = keras.Sequential([
+        keras.layers.Input((16,)),
+        keras.layers.Dense(32, activation="relu"),
+        keras.layers.BatchNormalization(),
+        keras.layers.Dropout(0.3),
+        keras.layers.Dense(4, activation="softmax"),
+    ])
+    x_warm = np.random.default_rng(10).normal(size=(64, 16)).astype(np.float32)
+    km(x_warm, training=True)
+    x = np.random.default_rng(11).normal(size=(16, 16)).astype(np.float32)
+
+    native = from_keras(km)
+    back = to_keras(native, x)
+    np.testing.assert_allclose(
+        np.asarray(back(x)), km.predict(x, verbose=0), rtol=1e-4, atol=1e-5
+    )
+
+    # train_mode import exports the TRUE moving statistics
+    native_t = from_keras(km, train_mode=True)
+    back_t = to_keras(native_t, x)
+    for w_orig, w_back in zip(km.get_weights(), back_t.get_weights()):
+        np.testing.assert_allclose(
+            np.asarray(w_orig), np.asarray(w_back), rtol=1e-6, atol=1e-7
+        )
+
+
+def test_export_recurrent_round_trip():
+    from distkeras_tpu.utils.keras_import import to_keras
+
+    km = keras.Sequential([
+        keras.layers.Input((6, 8)),
+        keras.layers.LSTM(12, return_sequences=True),
+        keras.layers.GRU(8),
+        keras.layers.Dense(3),
+    ])
+    x = np.random.default_rng(12).normal(size=(4, 6, 8)).astype(np.float32)
+    back = to_keras(from_keras(km), x)
+    np.testing.assert_allclose(
+        np.asarray(back(x)), km.predict(x, verbose=0), rtol=1e-4, atol=1e-5
+    )
+
+
+def test_export_rejects_native_models():
+    from distkeras_tpu.models import get_model
+    from distkeras_tpu.models.wrapper import Model
+    from distkeras_tpu.utils.keras_import import to_keras_config
+
+    import jax
+    import jax.numpy as jnp
+
+    mod = get_model("mlp", features=(8,), num_classes=2)
+    params = mod.init(jax.random.PRNGKey(0), jnp.zeros((1, 4)))
+    with pytest.raises(ValueError, match="Keras importer"):
+        to_keras_config(Model(mod, params))
+
+
+def test_train_mode_rejects_dropout_noise_shape():
+    """noise_shape is semantics-bearing only under train_mode: inference
+    import accepts it (dropout is identity), train_mode raises."""
+    km = keras.Sequential([
+        keras.layers.Input((4, 8)),
+        keras.layers.Dropout(0.5, noise_shape=(None, 1, 8)),
+        keras.layers.Dense(2),
+    ])
+    from_keras(km)  # inference import: fine
+    with pytest.raises(ValueError, match="noise_shape"):
+        from_keras(km, train_mode=True)
