@@ -5,10 +5,13 @@ Numbers land in BASELINE.md's results table (the reference publishes no
 figures — BASELINE.json "published": {} — so these are the framework's own
 committed measurements on the stated hardware).
 
-Zero-egress environment: MNIST/CIFAR-shaped workloads use synthetic data
-with identical shapes/dtypes (the arithmetic is identical to real data);
-accuracy-target configs use separable synthetic tasks and are labeled
-synthetic in the output.
+Configs 1-2 auto-detect a real ``mnist.npz`` (``$DK_DATA_DIR``,
+``benchmarks/data/``, ``~/.keras/datasets/``) and then measure
+epochs-to-99% on its test split; without one (this zero-egress
+environment downloads nothing) they run MNIST-shaped separable synthetic
+tasks, labeled as such in the JSON output. Throughput configs use
+synthetic data with identical shapes/dtypes (the arithmetic is identical
+to real data).
 """
 
 from __future__ import annotations
@@ -37,6 +40,58 @@ def synthetic_blobs(n, shape, classes, seed=0, spread=3.0):
     return feats.reshape((n,) + tuple(shape)), onehot, labels
 
 
+def _search_bases():
+    """Directories checked for real dataset files — fixed locations only
+    (no cwd-relative entries: the measured dataset must not depend on the
+    invocation directory). Separated so tests can patch it."""
+    return [
+        os.environ.get("DK_DATA_DIR"),
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), "data"),
+        os.path.expanduser("~/.keras/datasets"),
+    ]
+
+
+def _find_npz(name):
+    """Locate a real dataset file (zero-egress environment: nothing is
+    downloaded — the file is used iff someone placed it here)."""
+    for base in _search_bases():
+        if not base:
+            continue
+        p = os.path.join(base, f"{name}.npz")
+        if os.path.exists(p):
+            return p
+    return None
+
+
+def mnist_or_synthetic(shape, seed=0, spread=3.0, n=8192):
+    """(x, onehot, labels, eval_x, eval_labels, source) — real MNIST
+    pixels when an ``mnist.npz`` (keras layout) is present, else the
+    labeled synthetic task (VERDICT r2 #8: one code path, source stated
+    in the JSON output). On real data the accuracy target is judged on
+    the file's TEST split — train-set accuracy would read as a real-MNIST
+    result while measuring memorization."""
+    path = _find_npz("mnist")
+    if path is not None:
+        def prep(xa, ya):
+            xa = (np.asarray(xa).astype(np.float32) / 255.0).reshape(
+                (len(xa),) + tuple(shape)
+            )
+            return xa, np.asarray(ya).astype(np.int64).ravel()
+
+        with np.load(path) as z:
+            x, labels = prep(z["x_train"], z["y_train"])
+            if "x_test" in z:
+                eval_x, eval_labels = prep(z["x_test"], z["y_test"])
+            else:
+                eval_x, eval_labels = x, labels
+        onehot = np.eye(10, dtype=np.float32)[labels]
+        return x, onehot, labels, eval_x, eval_labels, f"mnist ({path})"
+    x, onehot, labels = synthetic_blobs(
+        n, shape, 10, seed=seed, spread=spread
+    )
+    return x, onehot, labels, x, labels, "synthetic-mnist-shaped"
+
+
 def _dataset(x, y):
     from distkeras_tpu.data.dataset import PartitionedDataset
 
@@ -45,55 +100,59 @@ def _dataset(x, y):
     )
 
 
-def _epochs_to_target(trainer_cls, model, x, y, labels, target=0.99,
-                      max_epochs=20, **kw):
-    from distkeras_tpu.models.wrapper import Model as ModelWrap
-
+def _epochs_to_target(trainer_cls, model, x, y, eval_x, eval_labels,
+                      target=0.99, max_epochs=20, **kw):
     ds = _dataset(x, y)
     t0 = time.perf_counter()
     for epochs in range(1, max_epochs + 1):
         trainer = trainer_cls(model=model, num_epoch=epochs, seed=0,
                               label_col="label", **kw)
         m = trainer.train(ds)
-        pred = np.asarray(m.predict(x)).argmax(1)
-        acc = (pred == labels).mean()
+        pred = np.asarray(m.predict(eval_x)).argmax(1)
+        acc = (pred == eval_labels).mean()
         if acc >= target:
             return epochs, acc, time.perf_counter() - t0
     return None, acc, time.perf_counter() - t0
 
 
 def config1():
-    """MNIST-shaped MLP, SingleTrainer: epochs to 99% (synthetic task)."""
+    """MNIST MLP, SingleTrainer: epochs to 99% (real pixels when an
+    mnist.npz is present; labeled synthetic otherwise)."""
     from distkeras_tpu.models import get_model
     from distkeras_tpu.trainers import SingleTrainer
 
-    x, y, labels = synthetic_blobs(8192, (784,), 10, spread=2.0)
+    x, y, labels, eval_x, eval_labels, source = mnist_or_synthetic(
+        (784,), spread=2.0
+    )
     epochs, acc, dt = _epochs_to_target(
-        SingleTrainer, get_model("mlp"), x, y, labels,
+        SingleTrainer, get_model("mlp"), x, y, eval_x, eval_labels,
         batch_size=128, learning_rate=0.05,
     )
     print(json.dumps({
         "config": 1, "metric": "mnist_mlp_single_epochs_to_99pct",
         "value": epochs, "unit": "epochs", "accuracy": round(float(acc), 4),
-        "wall_time_s": round(dt, 2), "data": "synthetic-mnist-shaped",
+        "wall_time_s": round(dt, 2), "data": source,
     }))
 
 
 def config2():
-    """MNIST-shaped CNN, ADAG 4 workers: epochs to 99% (synthetic task)."""
+    """MNIST CNN, ADAG 4 workers: epochs to 99% (real pixels when an
+    mnist.npz is present; labeled synthetic otherwise)."""
     from distkeras_tpu.models import get_model
     from distkeras_tpu.trainers import ADAG
 
-    x, y, labels = synthetic_blobs(8192, (28, 28, 1), 10, spread=1.0)
+    x, y, labels, eval_x, eval_labels, source = mnist_or_synthetic(
+        (28, 28, 1), spread=1.0
+    )
     epochs, acc, dt = _epochs_to_target(
-        ADAG, get_model("mnist_cnn"), x, y, labels,
+        ADAG, get_model("mnist_cnn"), x, y, eval_x, eval_labels,
         num_workers=4, communication_window=4,
         batch_size=128, learning_rate=0.05,
     )
     print(json.dumps({
         "config": 2, "metric": "mnist_cnn_adag4_epochs_to_99pct",
         "value": epochs, "unit": "epochs", "accuracy": round(float(acc), 4),
-        "wall_time_s": round(dt, 2), "data": "synthetic-mnist-shaped",
+        "wall_time_s": round(dt, 2), "data": source,
     }))
 
 

@@ -1,0 +1,52 @@
+"""Benchmark data loader: configs 1-2 use real MNIST pixels automatically
+when an mnist.npz is present, labeled synthetic otherwise — one code path,
+source stated (VERDICT r2 #8)."""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "benchmarks"
+))
+
+import run_all  # noqa: E402
+from run_all import mnist_or_synthetic  # noqa: E402
+
+
+def test_synthetic_fallback_when_no_file(monkeypatch, tmp_path):
+    # patch the whole search list: a real mnist.npz installed in any of
+    # the default locations must not turn this test red
+    monkeypatch.setattr(run_all, "_search_bases", lambda: [str(tmp_path)])
+    x, y, labels, ex, el, source = mnist_or_synthetic((784,), n=256)
+    assert source == "synthetic-mnist-shaped"
+    assert x.shape == (256, 784) and y.shape == (256, 10)
+    assert ex is x and el is labels  # synthetic evaluates on itself
+
+
+def test_real_mnist_detected_normalized_and_eval_split(monkeypatch, tmp_path):
+    rng = np.random.default_rng(0)
+    np.savez(
+        tmp_path / "mnist.npz",
+        x_train=rng.integers(0, 256, size=(128, 28, 28)).astype(np.uint8),
+        y_train=rng.integers(0, 10, size=(128,)).astype(np.uint8),
+        x_test=rng.integers(0, 256, size=(32, 28, 28)).astype(np.uint8),
+        y_test=rng.integers(0, 10, size=(32,)).astype(np.uint8),
+    )
+    monkeypatch.setattr(run_all, "_search_bases", lambda: [str(tmp_path)])
+    for shape in [(784,), (28, 28, 1)]:
+        x, y, labels, ex, el, source = mnist_or_synthetic(shape)
+        assert source.startswith("mnist (")
+        assert x.shape == (128,) + shape
+        assert x.dtype == np.float32 and 0.0 <= x.min() and x.max() <= 1.0
+        assert y.shape == (128, 10)
+        assert (y.argmax(1) == labels).all()
+        # accuracy is judged on the TEST split, not the training pixels
+        assert ex.shape == (32,) + shape and el.shape == (32,)
+
+
+def test_no_cwd_relative_search_path():
+    """Dataset selection must not depend on the invocation directory."""
+    bases = [b for b in run_all._search_bases() if b]
+    assert all(os.path.isabs(b) for b in bases)
