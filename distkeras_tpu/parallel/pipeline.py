@@ -59,25 +59,47 @@ def from_pipeline_params(pp_params, num_layers: int):
     return {"params": out}
 
 
-def pipeline_param_specs(template, pp_axis: str = "pp"):
-    blocks = jax.tree.map(
-        lambda x: P(*((pp_axis,) + (None,) * (x.ndim - 1))),
-        template["blocks"],
-    )
+def pipeline_param_specs(template, pp_axis: str = "pp",
+                         tp_axis: Optional[str] = None):
+    """Specs for the pipeline layout: stacked blocks lead with ``pp_axis``;
+    with ``tp_axis`` the per-layer feature dims additionally shard
+    Megatron-style — the stacked paths keep the same parent names
+    (qkv/out/mlp_up/mlp_down), so the tp pattern is delegated to
+    :func:`distkeras_tpu.parallel.spmd.lm_param_specs` and shifted one
+    axis right by the leading stack dim."""
+    if tp_axis is None:
+        blocks = jax.tree.map(
+            lambda x: P(*((pp_axis,) + (None,) * (x.ndim - 1))),
+            template["blocks"],
+        )
+    else:
+        from distkeras_tpu.parallel.spmd import lm_param_specs
+
+        tp_specs = lm_param_specs(template["blocks"], tp_axis=tp_axis)
+        blocks = jax.tree.map(
+            lambda s: P(pp_axis, *tuple(s)),
+            tp_specs, is_leaf=lambda x: isinstance(x, P),
+        )
     rest = jax.tree.map(lambda x: P(), template["rest"])
     return {"blocks": blocks, "rest": rest}
 
 
 def make_pp_lm_train_step(model, optimizer, mesh: Mesh,
                           params_template,
-                          pp_axis: str = "pp", dp_axis: str = "dp"):
-    """Jitted pipeline-parallel LM training step over a (pp, dp) mesh.
+                          pp_axis: str = "pp", dp_axis: str = "dp",
+                          tp_axis: Optional[str] = None):
+    """Jitted pipeline-parallel LM training step over a (pp, dp[, tp]) mesh.
 
-    ``model`` is a plain single-chip :class:`TransformerLM`
-    (``attention='standard'|'dense'``, ``tp_size=1``); its ``num_layers``
-    must divide the mesh's ``pp`` size evenly. ``params_template`` is the
-    full-size host init (the plain module layout); the returned step takes
-    the PIPELINE layout from :func:`to_pipeline_params`.
+    ``model`` is a :class:`TransformerLM` with ``attention='standard'|
+    'dense'`` and no MoE/ring; its ``num_layers`` must divide the mesh's
+    ``pp`` size evenly. With ``tp_axis`` given, the model's ``tp_size``
+    must equal the mesh's tp size: each pipeline stage's blocks then run
+    Megatron tensor-parallel over ``tp_axis`` (heads + MLP hidden sharded,
+    one psum per col→row pair inside the tick) — GPipe x Megatron, the
+    standard composition, in one ``shard_map`` program.
+    ``params_template`` is the full-size host init (the plain module
+    layout); the returned step takes the PIPELINE layout from
+    :func:`to_pipeline_params`.
 
     ``tokens`` is ``[M, B, T]`` — M microbatches, batch sharded over
     ``dp_axis``. Returns
@@ -90,22 +112,31 @@ def make_pp_lm_train_step(model, optimizer, mesh: Mesh,
     ax = dict(zip(mesh.axis_names, mesh.devices.shape))
     pp = ax.get(pp_axis, 1)
     dp = ax.get(dp_axis, 1)
+    tp = ax.get(tp_axis, 1) if tp_axis is not None else 1
     L = model.num_layers
     if L % pp != 0:
         raise ValueError(f"num_layers={L} not divisible by pp={pp}")
-    if (getattr(model, "tp_size", 1) != 1 or model.attention == "ring"
+    if (model.attention == "ring"
             or getattr(model, "moe_experts", 0) > 0):
         raise ValueError(
-            "pipeline step takes a plain single-chip TransformerLM "
-            "(tp_size=1, non-ring attention, no MoE); compose dp instead"
+            "pipeline step takes a plain TransformerLM (non-ring "
+            "attention, no MoE); it composes with dp and tp only"
+        )
+    if getattr(model, "tp_size", 1) != tp:
+        raise ValueError(
+            f"model.tp_size={getattr(model, 'tp_size', 1)} != mesh "
+            f"{tp_axis} size {tp} — build the model with matching tp_size"
         )
 
     template = to_pipeline_params(params_template, L)
-    pspec = pipeline_param_specs(template, pp_axis)
+    pspec = pipeline_param_specs(
+        template, pp_axis, tp_axis=tp_axis if tp > 1 else None
+    )
     ospec = opt_state_specs(optimizer, template, pspec)
 
     block_mod = Block(model.num_heads, dtype=model.dtype,
-                      attention=model.attention)
+                      attention=model.attention,
+                      tp_size=tp, tp_axis=tp_axis or "tp")
     embed_mod = nn.Embed(model.vocab_size, model.d_model, dtype=model.dtype)
     ln_mod = nn.LayerNorm(dtype=model.dtype)
     head_mod = nn.Dense(model.vocab_size, dtype=jnp.float32)
@@ -135,7 +166,10 @@ def make_pp_lm_train_step(model, optimizer, mesh: Mesh,
             perm = [(d, (d + 1) % pp) for d in range(pp)]
             # initial carries are constants (vma {}) but the loop makes
             # them device-varying; pcast declares that up front so the
-            # scan carry types match
+            # scan carry types match. NOT over tp: the row-parallel psum
+            # returns tp-INVARIANT activations, and marking them varying
+            # would make the replicated-bias grad transpose insert a
+            # spurious psum over tp (measured: exactly 2x grads at tp=2)
             x0 = jax.lax.pcast(
                 jnp.zeros((B_l, T, model.d_model), model.dtype),
                 (pp_axis, dp_axis), to="varying",

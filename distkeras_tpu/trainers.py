@@ -1438,24 +1438,33 @@ class LMTrainer(Trainer):
             n += 1
             yield np.ascontiguousarray(b[self.tokens_col], np.int32)
 
-    def _init_params(self, tokens: np.ndarray, sp: int):
-        """Full-size host init via a standard-attention twin (ring
-        attention only traces inside shard_map with the axis bound); the
-        twin's param tree is identical, and the SPMD step slices any
-        tp-sharded leaves onto the mesh."""
-        if self.params is not None:
-            return self.params
+    def _single_chip_twin(self):
+        """A standard-attention, unsharded twin of the model: identical
+        param tree, applies FULL-SIZE params outside any mesh. Used for
+        host init (ring attention only traces inside shard_map with the
+        axis bound) and as the module of the returned Model (a tp-sharded
+        module would expect 1/tp-size local param slices on predict)."""
         from distkeras_tpu.models import get_model
         from distkeras_tpu.models.registry import model_spec
 
+        if (getattr(self.model, "tp_size", 1) == 1
+                and self.model.attention != "ring"
+                and getattr(self.model, "ep_size", 1) == 1):
+            return self.model
         spec = model_spec(self.model)
         kwargs = dict(spec["kwargs"])
         kwargs.update(attention="standard", tp_size=1)
         if "ep_size" in kwargs:
             kwargs["ep_size"] = 1  # full expert banks; mesh slices them
-        twin = get_model(spec["name"], **kwargs)
+        return get_model(spec["name"], **kwargs)
+
+    def _init_params(self, tokens: np.ndarray, sp: int):
+        """Full-size host init via the single-chip twin; the SPMD step
+        slices any tp/ep-sharded leaves onto the mesh."""
+        if self.params is not None:
+            return self.params
         T_local = tokens.shape[1] // sp
-        self.params = twin.init(
+        self.params = self._single_chip_twin().init(
             jax.random.PRNGKey(self.seed),
             jnp.asarray(tokens[:1, :T_local], jnp.int32),
         )
@@ -1660,7 +1669,7 @@ class LMTrainer(Trainer):
         self.params = jax.tree.map(np.asarray, params)
         self.history = history
         self.executor_histories = [history]
-        return Model(self.model, self.params)
+        return Model(self._single_chip_twin(), self.params)
 
     def _train_pp(self, dataset, shuffle: bool = False) -> Model:
         """Pipeline-parallel training: ``axes={"pp": ..., "dp": ...}``.
@@ -1683,21 +1692,27 @@ class LMTrainer(Trainer):
 
         axes = dict(self.axes)
         pp = axes.pop("pp")
-        for bad in ("sp", "tp", "ep"):
+        tp = axes.pop("tp", 1)
+        for bad in ("sp", "ep"):
             if axes.pop(bad, 1) > 1:
                 raise ValueError(
-                    f"pipeline training shards (pp, dp) only; drop '{bad}' "
-                    "(see ARCHITECTURE.md on pp composition)"
+                    f"pipeline training shards (pp, dp, tp) only; drop "
+                    f"'{bad}' (see ARCHITECTURE.md on pp composition)"
                 )
         dp = axes.pop("dp", 1)
         if axes:
             raise ValueError(f"unknown mesh axes with pp: {sorted(axes)}")
-        if (getattr(self.model, "tp_size", 1) != 1
-                or self.model.attention == "ring"
+        if (self.model.attention == "ring"
                 or getattr(self.model, "moe_experts", 0) > 0):
             raise ValueError(
-                "pp training takes a plain TransformerLM (tp_size=1, "
-                "non-ring attention, no MoE)"
+                "pp training takes a plain TransformerLM "
+                "(non-ring attention, no MoE)"
+            )
+        if getattr(self.model, "tp_size", 1) != tp:
+            raise ValueError(
+                f"model.tp_size={getattr(self.model, 'tp_size', 1)} != "
+                f"mesh tp size {tp} — build the model with tp_size={tp}, "
+                "tp_axis='tp'"
             )
         # dp MAJOR, pp minor: multi-process meshes then split along dp, so
         # each process holds complete pipelines and feeds only its own
@@ -1710,7 +1725,10 @@ class LMTrainer(Trainer):
                 f"the process count ({jax.process_count()}) so every "
                 "process holds complete pipelines and disjoint batch rows"
             )
-        mesh = make_mesh({"dp": dp, "pp": pp})
+        # tp innermost: the per-matmul psums ride the fastest links, the
+        # per-tick pp ppermute the next ring out, dp's once-per-step
+        # gradient reduction the outermost
+        mesh = make_mesh({"dp": dp, "pp": pp, "tp": tp})
 
         # Checkpoints store the PLAIN module layout for params AND the
         # optimizer state's param-mirror subtrees (mu/nu/trace/... embed a
@@ -1783,7 +1801,8 @@ class LMTrainer(Trainer):
 
         optimizer = get_optimizer(self.worker_optimizer, self.learning_rate)
         step = make_pp_lm_train_step(
-            self.model, optimizer, mesh, params_template=self.params
+            self.model, optimizer, mesh, params_template=self.params,
+            tp_axis="tp" if tp > 1 else None,
         )
 
         if n_rows < B:
@@ -1862,4 +1881,4 @@ class LMTrainer(Trainer):
         self.params = from_pipeline_params(_gather_host(pp_params), L)
         self.history = history
         self.executor_histories = [history]
-        return Model(self.model, self.params)
+        return Model(self._single_chip_twin(), self.params)

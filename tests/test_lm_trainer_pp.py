@@ -154,3 +154,26 @@ def test_pp_trainer_validation_errors():
     with pytest.raises(ValueError, match="microbatches only"):
         LMTrainer(make_model(), axes={"dp": 2}, microbatches=4,
                   batch_size=16)
+
+
+def test_pp_tp_through_trainer_matches_unsharded():
+    """axes={'pp':2,'dp':2,'tp':2} trains through the Trainer API with the
+    same trajectory as the plain path."""
+    kw = dict(batch_size=16, num_epoch=2, worker_optimizer="adam",
+              learning_rate=1e-2, seed=11)
+    ds = token_dataset(seed=12)
+    tp_model = get_model("transformer_lm", attention="standard", tp_size=2,
+                         tp_axis="tp", **LM_KW)
+    t_pp = LMTrainer(tp_model, axes={"pp": 2, "dp": 2, "tp": 2},
+                     microbatches=4, **kw)
+    m_pp = t_pp.train(ds)
+
+    t_ref = LMTrainer(make_model(), axes={"dp": 1}, **kw)
+    t_ref.train(ds)
+    np.testing.assert_allclose(
+        [r["loss"] for r in t_pp.history],
+        [r["loss"] for r in t_ref.history],
+        rtol=2e-4, atol=2e-5,
+    )
+    logits = m_pp.predict(np.asarray(ds.column("tokens"))[:2])
+    assert np.isfinite(np.asarray(logits)).all()

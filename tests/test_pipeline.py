@@ -105,5 +105,52 @@ def test_pp_rejects_bad_configs():
     params4 = get_model("transformer_lm", attention="standard", **LM_KW).init(
         jax.random.PRNGKey(0), jnp.zeros((1, T), jnp.int32)
     )
-    with pytest.raises(ValueError, match="plain single-chip"):
+    with pytest.raises(ValueError, match="plain TransformerLM"):
         make_pp_lm_train_step(ring, optax.sgd(0.1), mesh, params4)
+
+
+def test_pp_tp_composition_matches_unsharded():
+    """GPipe x Megatron: pp=2 x dp=2 x tp=2 reproduces the unsharded
+    loss AND parameter update (VERDICT r2 #9 — one non-trivial
+    parallelism composition)."""
+    mesh = make_mesh({"dp": 2, "pp": 2, "tp": 2})
+    kw = dict(LM_KW)
+    model_tp = get_model("transformer_lm", attention="standard", tp_size=2,
+                         tp_axis="tp", **kw)
+    model_ref = get_model("transformer_lm", attention="standard", **kw)
+    tokens = jnp.asarray(
+        np.random.default_rng(9).integers(0, 64, size=(M, B, T)), jnp.int32
+    )
+    params = model_ref.init(jax.random.PRNGKey(0), tokens[0])
+    optimizer = optax.sgd(0.1)
+    step = make_pp_lm_train_step(model_tp, optimizer, mesh, params,
+                                 tp_axis="tp")
+    ppp = to_pipeline_params(params, LM_KW["num_layers"])
+    new_pp, _, loss = step(ppp, optimizer.init(ppp), tokens)
+
+    ref, p_ref = ref_loss_and_step(model_ref, params, tokens, optimizer)
+    np.testing.assert_allclose(float(loss), ref, rtol=1e-5)
+    restored = from_pipeline_params(
+        jax.tree.map(np.asarray, new_pp), LM_KW["num_layers"]
+    )
+    ref_flat = dict(
+        (jax.tree_util.keystr(k), v)
+        for k, v in jax.tree_util.tree_leaves_with_path(p_ref)
+    )
+    for key, leaf in jax.tree_util.tree_leaves_with_path(restored):
+        np.testing.assert_allclose(
+            np.asarray(leaf), np.asarray(ref_flat[jax.tree_util.keystr(key)]),
+            rtol=2e-4, atol=2e-5, err_msg=jax.tree_util.keystr(key),
+        )
+
+
+def test_pp_tp_rejects_mismatched_tp_size():
+    mesh = make_mesh({"dp": 2, "pp": 2, "tp": 2})
+    model = get_model("transformer_lm", attention="standard", **LM_KW)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, T), jnp.int32)
+    )
+    import pytest
+    with pytest.raises(ValueError, match="tp_size"):
+        make_pp_lm_train_step(model, optax.sgd(0.1), mesh, params,
+                              tp_axis="tp")
