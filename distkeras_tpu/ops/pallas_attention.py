@@ -1,0 +1,347 @@
+"""Pallas TPU causal flash attention with causal tile SKIPPING.
+
+The pure-JAX blocked kernel (:mod:`distkeras_tpu.ops.flash_attention`)
+streams KV blocks but computes every (q, k) tile and masks the upper
+triangle — half the attention FLOPs are thrown away. This kernel walks,
+for each query block i, only the k blocks j <= i (a ``fori_loop`` whose
+trip count depends on ``pl.program_id``), so causal attention does the
+causal half of the work. Same streaming log-sum-exp accumulation; the
+backward pass is the Dao recompute scheme split into a dq kernel (rows,
+k <= q) and a dk/dv kernel (columns, q >= k), each walking only its
+causal wedge.
+
+Layout: attention heads are folded into the batch ([B*H, T, hd]) so every
+tile is a clean 2-D (block, head_dim) VMEM tile — hd is a multiple of 128
+(the lane width) by construction of the flagship models.
+
+Numerics match the dense/blocked kernels: bf16 matmul operands, f32
+accumulation (``preferred_element_type``), f32 online softmax state.
+
+Requires T divisible by the (clamped) block, head_dim % 128 == 0, and
+K+V within the VMEM budget — :func:`supports` is the gate, and the
+wrapper RAISES on unsupported shapes; falling back is the caller's job
+(models.transformer keeps 'blocked' for shapes this kernel won't serve).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+DEFAULT_BLOCK = 512
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, l_ref, *, block: int,
+                scale: float):
+    # q_ref [1, bq, hd] (query block i of batch-head bh); k/v [1, T, hd];
+    # l_ref is the FULL [BH, T] logsumexp buffer (tiny, whole in VMEM —
+    # a (1, block) tile would violate the (8, 128) tiling constraint)
+    bh = pl.program_id(0)
+    i = pl.program_id(1)
+    bq = block
+    q = (q_ref[0].astype(jnp.float32) * scale).astype(q_ref.dtype)
+    hd = q.shape[-1]
+    q_pos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
+
+    def body(j, carry):
+        o, m, l = carry
+        kb = k_ref[0, pl.ds(j * bq, bq), :]
+        vb = v_ref[0, pl.ds(j * bq, bq), :]
+        s = jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [bq, bq]
+        k_pos = j * bq + jax.lax.broadcasted_iota(jnp.int32, (1, bq), 1)
+        s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        corr = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new)
+        l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(v_ref.dtype), vb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        o = o * corr + pv
+        return o, m_new, l
+
+    o0 = jnp.zeros((bq, hd), jnp.float32)
+    m0 = jnp.full((bq, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq, 1), jnp.float32)
+    # THE causal win: only k blocks j <= i exist for this program
+    o, m, l = jax.lax.fori_loop(0, i + 1, body, (o0, m0, l0))
+    l_safe = jnp.maximum(l, 1e-30)
+    o_ref[0] = (o / l_safe).astype(o_ref.dtype)
+    # per-row logsumexp of the scaled logits (backward recompute needs it)
+    l_ref[bh, pl.ds(i * bq, bq)] = (m + jnp.log(l_safe))[:, 0]
+
+
+def _fwd(q3, k3, v3, block: int, scale: float):
+    BH, T, hd = q3.shape
+    nq = T // block
+    grid = (BH, nq)
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, block=block, scale=scale),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block, hd), lambda b, i: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, T, hd), lambda b, i: (b, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, T, hd), lambda b, i: (b, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block, hd), lambda b, i: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),  # full [BH, T] lse
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, T, hd), q3.dtype),
+            jax.ShapeDtypeStruct((BH, T), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q3, k3, v3)
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# backward (Dao recompute): dq walks k<=q; dk/dv walk q>=k
+# ---------------------------------------------------------------------------
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               *, block: int, scale: float):
+    bh = pl.program_id(0)
+    i = pl.program_id(1)
+    bq = block
+    q = (q_ref[0].astype(jnp.float32) * scale).astype(q_ref.dtype)
+    do = do_ref[0]
+    lse = lse_ref[bh, pl.ds(i * bq, bq)][:, None]
+    delta = delta_ref[bh, pl.ds(i * bq, bq)][:, None]
+    hd = q.shape[-1]
+    q_pos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
+
+    def body(j, dq):
+        kb = k_ref[0, pl.ds(j * bq, bq), :]
+        vb = v_ref[0, pl.ds(j * bq, bq), :]
+        s = jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        k_pos = j * bq + jax.lax.broadcasted_iota(jnp.int32, (1, bq), 1)
+        s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse)  # exact probabilities via saved logsumexp
+        dp = jax.lax.dot_general(
+            do, vb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta)
+        dq = dq + jax.lax.dot_general(
+            ds.astype(kb.dtype), kb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return dq
+
+    dq = jax.lax.fori_loop(
+        0, i + 1, body, jnp.zeros((bq, hd), jnp.float32)
+    )
+    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, *, block: int, scale: float):
+    bh = pl.program_id(0)
+    j = pl.program_id(1)
+    nq = pl.num_programs(1)
+    bq = block
+    kb = k_ref[0]
+    vb = v_ref[0]
+    hd = kb.shape[-1]
+    k_pos = j * bq + jax.lax.broadcasted_iota(jnp.int32, (1, bq), 1)
+
+    def body(i, carry):
+        dk, dv = carry
+        q = (q_ref[0, pl.ds(i * bq, bq), :].astype(jnp.float32)
+             * scale).astype(q_ref.dtype)
+        do = do_ref[0, pl.ds(i * bq, bq), :]
+        lse = lse_ref[bh, pl.ds(i * bq, bq)][:, None]
+        delta = delta_ref[bh, pl.ds(i * bq, bq)][:, None]
+        s = jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        q_pos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
+        s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse)
+        pc = p.astype(do.dtype)
+        dv = dv + jax.lax.dot_general(
+            pc, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do, vb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = (p * (dp - delta)).astype(q.dtype)
+        dk = dk + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return dk, dv
+
+    dk0 = jnp.zeros((bq, hd), jnp.float32)
+    dv0 = jnp.zeros((bq, hd), jnp.float32)
+    # columns: only q blocks i >= j attend to this k block
+    dk, dv = jax.lax.fori_loop(j, nq, body, (dk0, dv0))
+    # no extra scale: the body's q is already scaled, so ds^T @ q_scaled
+    # IS the gradient w.r.t. the unscaled k
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _bwd(q3, k3, v3, out, lse, do3, block: int, scale: float):
+    BH, T, hd = q3.shape
+    nq = T // block
+    delta = jnp.sum(
+        do3.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
+    )  # [BH, T]
+    common_in = [
+        pl.BlockSpec((1, T, hd), lambda b, i: (b, 0, 0),
+                     memory_space=pltpu.VMEM),
+    ]
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, block=block, scale=scale),
+        grid=(BH, nq),
+        in_specs=[
+            pl.BlockSpec((1, block, hd), lambda b, i: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            common_in[0], common_in[0],
+            pl.BlockSpec((1, block, hd), lambda b, i: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),  # full lse
+            pl.BlockSpec(memory_space=pltpu.VMEM),  # full delta
+        ],
+        out_specs=pl.BlockSpec((1, block, hd), lambda b, i: (b, i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((BH, T, hd), q3.dtype),
+        interpret=_interpret(),
+    )(q3, k3, v3, do3, lse, delta)
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, block=block, scale=scale),
+        grid=(BH, nq),
+        in_specs=[
+            common_in[0],
+            pl.BlockSpec((1, block, hd), lambda b, j: (b, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block, hd), lambda b, j: (b, j, 0),
+                         memory_space=pltpu.VMEM),
+            common_in[0],
+            pl.BlockSpec(memory_space=pltpu.VMEM),  # full lse
+            pl.BlockSpec(memory_space=pltpu.VMEM),  # full delta
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block, hd), lambda b, j: (b, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block, hd), lambda b, j: (b, j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, T, hd), k3.dtype),
+            jax.ShapeDtypeStruct((BH, T, hd), v3.dtype),
+        ],
+        interpret=_interpret(),
+    )(q3, k3, v3, do3, lse, delta)
+    return dq, dk, dv
+
+
+def _interpret() -> bool:
+    """Interpret mode off-TPU (CPU test meshes run the same program)."""
+    return jax.default_backend() != "tpu"
+
+
+# ---------------------------------------------------------------------------
+# public op
+# ---------------------------------------------------------------------------
+
+
+def _to_bh(x):
+    B, T, H, hd = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(B * H, T, hd)
+
+
+def _from_bh(x, B, H):
+    BH, T, hd = x.shape
+    return x.reshape(B, H, T, hd).transpose(0, 2, 1, 3)
+
+
+# Per-program K+V VMEM budget: the whole [T, hd] K and V live on-chip
+# (double-buffered by the pipeline), so 2 * T * hd * itemsize must stay
+# well under the ~16 MB VMEM. 8 MB leaves room for the q/o/do blocks, the
+# f32 logits tile and accumulators (measured: T=8192/hd=256 at 8.4 MB
+# fails to compile; T=4096 runs 1.9x faster than the blocked kernel).
+MAX_KV_VMEM_BYTES = 8 * 1024 * 1024
+
+
+def supports(T: int, hd: int, block: int = DEFAULT_BLOCK,
+             itemsize: int = 2) -> bool:
+    """Shapes this kernel serves: sequence divisible by the block after
+    clamping, lane-aligned head dim, K+V within the VMEM budget."""
+    b = min(block, T)
+    # strict: T=8192/hd=256 bf16 sits exactly at 8 MB and fails to compile
+    return (T % b == 0 and hd % 128 == 0
+            and 2 * T * hd * itemsize < MAX_KV_VMEM_BYTES)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def pallas_causal_attention(q, k, v, block: int = DEFAULT_BLOCK):
+    """Causal flash attention, [B, T, H, hd] -> [B, T, H, hd].
+
+    ``softmax(q k^T / sqrt(hd) + causal mask) v`` with causal tile
+    skipping on TPU (interpret mode elsewhere). See :func:`supports`.
+    """
+    out, _ = _fwd_res(q, k, v, block)
+    return out
+
+
+def _fwd_res(q, k, v, block):
+    B, T, H, hd = q.shape
+    b = min(block, T)
+    if not supports(T, hd, block):
+        raise ValueError(
+            f"pallas attention needs T % {b} == 0 and hd % 128 == 0; got "
+            f"T={T}, hd={hd} — use attention='blocked'"
+        )
+    scale = 1.0 / math.sqrt(hd)
+    q3, k3, v3 = _to_bh(q), _to_bh(k), _to_bh(v)
+    out3, lse = _fwd(q3, k3, v3, b, scale)
+    return _from_bh(out3, B, H), (q3, k3, v3, out3, lse, B, H, b)
+
+
+def _vjp_fwd(q, k, v, block):
+    out, res = _fwd_res(q, k, v, block)
+    return out, res
+
+
+def _vjp_bwd(block, res, g):
+    q3, k3, v3, out3, lse, B, H, b = res
+    scale = 1.0 / math.sqrt(q3.shape[-1])
+    do3 = _to_bh(g)
+    dq3, dk3, dv3 = _bwd(q3, k3, v3, out3, lse, do3, b, scale)
+    return (_from_bh(dq3, B, H).astype(g.dtype),
+            _from_bh(dk3, B, H).astype(g.dtype),
+            _from_bh(dv3, B, H).astype(g.dtype))
+
+
+pallas_causal_attention.defvjp(_vjp_fwd, _vjp_bwd)

@@ -1,0 +1,97 @@
+"""Pallas causal-skip flash attention: exact vs dense attention, forward
+AND backward (interpret mode on the CPU test mesh; the same program runs
+compiled on TPU, where it measures ~1.9x over the blocked kernel)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distkeras_tpu.ops.pallas_attention import (
+    DEFAULT_BLOCK,
+    pallas_causal_attention,
+    supports,
+)
+
+
+def dense(q, k, v):
+    B, T, H, hd = q.shape
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) / np.sqrt(hd)
+    mask = jnp.tril(jnp.ones((T, T), bool))
+    s = jnp.where(mask[None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(q.dtype), v)
+
+
+def qkv(B=2, T=256, H=2, hd=128, seed=0, dtype=jnp.float32):
+    rng = np.random.default_rng(seed)
+    mk = lambda: jnp.asarray(rng.normal(size=(B, T, H, hd)), dtype)
+    return mk(), mk(), mk()
+
+
+def test_forward_matches_dense():
+    q, k, v = qkv()
+    out = pallas_causal_attention(q, k, v, 128)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(dense(q, k, v)), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_backward_matches_dense():
+    q, k, v = qkv(seed=1)
+
+    def loss(fn):
+        return lambda q, k, v: jnp.sum(fn(q, k, v).astype(jnp.float32) ** 2)
+
+    gp = jax.grad(loss(lambda q, k, v: pallas_causal_attention(q, k, v, 128)),
+                  argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss(dense), argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gp, gd, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-4,
+            err_msg=f"d{name}",
+        )
+
+
+def test_single_block_sequence():
+    """T smaller than the block: the block clamps to T."""
+    q, k, v = qkv(T=128, seed=2)
+    out = pallas_causal_attention(q, k, v)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(dense(q, k, v)), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_supports_gate():
+    assert supports(2048, 256)
+    assert supports(4096, 256)
+    assert not supports(8192, 256)  # K+V exceed the VMEM budget
+    assert not supports(2048, 64)  # sub-lane head dim
+    assert not supports(1000, 128)  # not block-divisible
+    assert supports(100, 128)  # block clamps to T
+
+
+def test_unsupported_shapes_raise():
+    q, k, v = qkv(T=768, hd=128, seed=3)
+    with pytest.raises(ValueError, match="pallas attention"):
+        pallas_causal_attention(q, k, v, 512)  # 768 % 512 != 0
+
+
+def test_model_standard_mode_stays_correct():
+    """'standard' auto-select (pallas on TPU, blocked here) matches the
+    explicitly-dense model output."""
+    from distkeras_tpu.models import get_model
+
+    kw = dict(vocab_size=64, d_model=128, num_heads=1, num_layers=1,
+              max_len=1024, dtype=jnp.float32)
+    toks = jnp.asarray(
+        np.random.default_rng(4).integers(0, 64, size=(2, 1024)), jnp.int32
+    )
+    std = get_model("transformer_lm", attention="standard", **kw)
+    params = std.init(jax.random.PRNGKey(0), toks)
+    dense_m = get_model("transformer_lm", attention="dense", **kw)
+    np.testing.assert_allclose(
+        np.asarray(std.apply(params, toks)),
+        np.asarray(dense_m.apply(params, toks)),
+        rtol=2e-4, atol=2e-4,
+    )
