@@ -137,10 +137,9 @@ def lm_bench():
     from distkeras_tpu.ops import pallas_attention
 
     # the model's own selection predicate, so the recorded config can't
-    # lie about which kernel actually ran (e.g. the T=8192 VMEM fallback)
+    # lie about which kernel actually ran
     kernel = ("pallas-causal"
-              if (jax.default_backend() == "tpu"
-                  and pallas_attention.supports(T, D // H, itemsize=2))
+              if pallas_attention.preferred(T, D // H, B * H)
               else "blocked")
     out = {
         "lm_tokens_per_sec_per_chip": round(steps * B * T / dt, 1),

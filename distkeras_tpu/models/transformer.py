@@ -146,15 +146,13 @@ class CausalSelfAttention(nn.Module):
                 from distkeras_tpu.ops import pallas_attention
 
                 # the Pallas kernel skips the masked causal tiles the
-                # blocked kernel computes (measured ~1.9x at T=2048-4096);
-                # interpret mode off-TPU is correct but slow, so only TPU
-                # auto-selects it. itemsize matters: an f32 model's K+V
-                # hit the VMEM budget at half the bf16 sequence length
+                # blocked kernel computes (measured 1.6-2.4x at
+                # T=2048-8192); interpret mode off-TPU is correct but
+                # slow, so only TPU auto-selects it, via the shared
+                # predicate (batch_heads bounds the kernel's VMEM-resident
+                # f32 lse/delta buffers)
                 mode = ("pallas"
-                        if (jax.default_backend() == "tpu"
-                            and pallas_attention.supports(
-                                T, hd,
-                                itemsize=jnp.dtype(self.dtype).itemsize))
+                        if pallas_attention.preferred(T, hd, B * H)
                         else "blocked")
         if mode == "ring":
             from distkeras_tpu.ops.ring_attention import ring_attention

@@ -2,13 +2,16 @@
 
 The pure-JAX blocked kernel (:mod:`distkeras_tpu.ops.flash_attention`)
 streams KV blocks but computes every (q, k) tile and masks the upper
-triangle — half the attention FLOPs are thrown away. This kernel walks,
-for each query block i, only the k blocks j <= i (a ``fori_loop`` whose
-trip count depends on ``pl.program_id``), so causal attention does the
-causal half of the work. Same streaming log-sum-exp accumulation; the
-backward pass is the Dao recompute scheme split into a dq kernel (rows,
-k <= q) and a dk/dv kernel (columns, q >= k), each walking only its
-causal wedge.
+triangle — half the attention FLOPs are thrown away. Here the KV walk is
+a third GRID dimension with the causal wedge enforced by ``pl.when``:
+for query block i only k blocks j <= i do work, skipped tiles cost
+nothing (their KV index map clamps to the diagonal block, so the
+pipeline doesn't even re-fetch), and the online-softmax state lives in
+VMEM scratch carried across the inner grid steps. Per-block KV DMA means
+NO full-sequence VMEM residency — T=8192+ runs where a whole-KV design
+exceeds the ~16 MB budget. The backward pass is the Dao recompute scheme
+split into a dq kernel (rows, k <= q) and a dk/dv kernel (columns,
+q >= k), each walking only its causal wedge the same way.
 
 Layout: attention heads are folded into the batch ([B*H, T, hd]) so every
 tile is a clean 2-D (block, head_dim) VMEM tile — hd is a multiple of 128
@@ -17,10 +20,15 @@ tile is a clean 2-D (block, head_dim) VMEM tile — hd is a multiple of 128
 Numerics match the dense/blocked kernels: bf16 matmul operands, f32
 accumulation (``preferred_element_type``), f32 online softmax state.
 
-Requires T divisible by the (clamped) block, head_dim % 128 == 0, and
-K+V within the VMEM budget — :func:`supports` is the gate, and the
-wrapper RAISES on unsupported shapes; falling back is the caller's job
-(models.transformer keeps 'blocked' for shapes this kernel won't serve).
+Requires T divisible by the (clamped) block and head_dim % 128 == 0 —
+:func:`supports` is the gate, and the wrapper RAISES on unsupported
+shapes; falling back is the caller's job (models.transformer keeps
+'blocked' for shapes this kernel won't serve).
+
+Measured on v5e vs the blocked kernel (value+grad, B·H=64→16, hd=256):
+1.58× @T=2048, 2.17× @T=4096, 2.36× @T=8192; the flagship training step
+gains +39% at T=2048 and +60% at T=4096, and T=8192 trains at 33.8k
+tokens/sec where the whole-KV design could not compile.
 """
 
 from __future__ import annotations
@@ -38,72 +46,81 @@ _NEG_INF = -1e30
 DEFAULT_BLOCK = 512
 
 
+def _interpret() -> bool:
+    """Interpret mode off-TPU (CPU test meshes run the same program)."""
+    return jax.default_backend() != "tpu"
+
+
 # ---------------------------------------------------------------------------
-# forward
+# forward: grid (BH, nq, nk), online softmax state in scratch
 # ---------------------------------------------------------------------------
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, l_ref, *, block: int,
-                scale: float):
-    # q_ref [1, bq, hd] (query block i of batch-head bh); k/v [1, T, hd];
-    # l_ref is the FULL [BH, T] logsumexp buffer (tiny, whole in VMEM —
-    # a (1, block) tile would violate the (8, 128) tiling constraint)
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, l_ref, acc, m_s, l_s,
+                *, block: int, scale: float):
     bh = pl.program_id(0)
     i = pl.program_id(1)
+    j = pl.program_id(2)
     bq = block
-    q = (q_ref[0].astype(jnp.float32) * scale).astype(q_ref.dtype)
-    hd = q.shape[-1]
-    q_pos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
 
-    def body(j, carry):
-        o, m, l = carry
-        kb = k_ref[0, pl.ds(j * bq, bq), :]
-        vb = v_ref[0, pl.ds(j * bq, bq), :]
+    @pl.when(j == 0)
+    def _():
+        acc[:] = jnp.zeros_like(acc)
+        m_s[:] = jnp.full_like(m_s, _NEG_INF)
+        l_s[:] = jnp.zeros_like(l_s)
+
+    @pl.when(j <= i)
+    def _():
+        q = (q_ref[0].astype(jnp.float32) * scale).astype(q_ref.dtype)
         s = jax.lax.dot_general(
-            q, kb, (((1,), (1,)), ((), ())),
+            q, k_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # [bq, bq]
+        q_pos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
         k_pos = j * bq + jax.lax.broadcasted_iota(jnp.int32, (1, bq), 1)
         s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        corr = jnp.exp(m - m_new)
+        m_old = m_s[:]
+        m_new = jnp.maximum(m_old, jnp.max(s, axis=-1, keepdims=True))
+        corr = jnp.exp(m_old - m_new)
         p = jnp.exp(s - m_new)
-        l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        l_s[:] = l_s[:] * corr + jnp.sum(p, axis=-1, keepdims=True)
+        m_s[:] = m_new
         pv = jax.lax.dot_general(
-            p.astype(v_ref.dtype), vb, (((1,), (0,)), ((), ())),
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        o = o * corr + pv
-        return o, m_new, l
+        acc[:] = acc[:] * corr + pv
 
-    o0 = jnp.zeros((bq, hd), jnp.float32)
-    m0 = jnp.full((bq, 1), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((bq, 1), jnp.float32)
-    # THE causal win: only k blocks j <= i exist for this program
-    o, m, l = jax.lax.fori_loop(0, i + 1, body, (o0, m0, l0))
-    l_safe = jnp.maximum(l, 1e-30)
-    o_ref[0] = (o / l_safe).astype(o_ref.dtype)
-    # per-row logsumexp of the scaled logits (backward recompute needs it)
-    l_ref[bh, pl.ds(i * bq, bq)] = (m + jnp.log(l_safe))[:, 0]
+    # j == i is the last tile with work for this query block: finalize
+    # (j > i iterations only clamp-fetch the diagonal KV block again)
+    @pl.when(j == i)
+    def _():
+        l_safe = jnp.maximum(l_s[:], 1e-30)
+        o_ref[0] = (acc[:] / l_safe).astype(o_ref.dtype)
+        # per-row logsumexp of the scaled logits, for backward recompute;
+        # full [BH, T] buffer (a (1, block) tile would violate the
+        # (8, 128) tiling constraint)
+        l_ref[bh, pl.ds(i * bq, bq)] = (m_s[:] + jnp.log(l_safe))[:, 0]
 
 
 def _fwd(q3, k3, v3, block: int, scale: float):
     BH, T, hd = q3.shape
     nq = T // block
-    grid = (BH, nq)
-    out, lse = pl.pallas_call(
+
+    def kv_idx(b, i, j):
+        return (b, jnp.minimum(i, j), 0)
+
+    return pl.pallas_call(
         functools.partial(_fwd_kernel, block=block, scale=scale),
-        grid=grid,
+        grid=(BH, nq, nq),
         in_specs=[
-            pl.BlockSpec((1, block, hd), lambda b, i: (b, i, 0),
+            pl.BlockSpec((1, block, hd), lambda b, i, j: (b, i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, T, hd), lambda b, i: (b, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, T, hd), lambda b, i: (b, 0, 0),
-                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block, hd), kv_idx, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block, hd), kv_idx, memory_space=pltpu.VMEM),
         ],
         out_specs=[
-            pl.BlockSpec((1, block, hd), lambda b, i: (b, i, 0),
+            pl.BlockSpec((1, block, hd), lambda b, i, j: (b, i, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec(memory_space=pltpu.VMEM),  # full [BH, T] lse
         ],
@@ -111,9 +128,13 @@ def _fwd(q3, k3, v3, block: int, scale: float):
             jax.ShapeDtypeStruct((BH, T, hd), q3.dtype),
             jax.ShapeDtypeStruct((BH, T), jnp.float32),
         ],
+        scratch_shapes=[
+            pltpu.VMEM((block, hd), jnp.float32),
+            pltpu.VMEM((block, 1), jnp.float32),
+            pltpu.VMEM((block, 1), jnp.float32),
+        ],
         interpret=_interpret(),
     )(q3, k3, v3)
-    return out, lse
 
 
 # ---------------------------------------------------------------------------
@@ -122,60 +143,21 @@ def _fwd(q3, k3, v3, block: int, scale: float):
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-               *, block: int, scale: float):
+               dq_acc, *, block: int, scale: float):
     bh = pl.program_id(0)
     i = pl.program_id(1)
+    j = pl.program_id(2)
     bq = block
-    q = (q_ref[0].astype(jnp.float32) * scale).astype(q_ref.dtype)
-    do = do_ref[0]
-    lse = lse_ref[bh, pl.ds(i * bq, bq)][:, None]
-    delta = delta_ref[bh, pl.ds(i * bq, bq)][:, None]
-    hd = q.shape[-1]
-    q_pos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
 
-    def body(j, dq):
-        kb = k_ref[0, pl.ds(j * bq, bq), :]
-        vb = v_ref[0, pl.ds(j * bq, bq), :]
-        s = jax.lax.dot_general(
-            q, kb, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        k_pos = j * bq + jax.lax.broadcasted_iota(jnp.int32, (1, bq), 1)
-        s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
-        p = jnp.exp(s - lse)  # exact probabilities via saved logsumexp
-        dp = jax.lax.dot_general(
-            do, vb, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        ds = p * (dp - delta)
-        dq = dq + jax.lax.dot_general(
-            ds.astype(kb.dtype), kb, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        return dq
+    @pl.when(j == 0)
+    def _():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
 
-    dq = jax.lax.fori_loop(
-        0, i + 1, body, jnp.zeros((bq, hd), jnp.float32)
-    )
-    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
-
-
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, *, block: int, scale: float):
-    bh = pl.program_id(0)
-    j = pl.program_id(1)
-    nq = pl.num_programs(1)
-    bq = block
-    kb = k_ref[0]
-    vb = v_ref[0]
-    hd = kb.shape[-1]
-    k_pos = j * bq + jax.lax.broadcasted_iota(jnp.int32, (1, bq), 1)
-
-    def body(i, carry):
-        dk, dv = carry
-        q = (q_ref[0, pl.ds(i * bq, bq), :].astype(jnp.float32)
-             * scale).astype(q_ref.dtype)
-        do = do_ref[0, pl.ds(i * bq, bq), :]
+    @pl.when(j <= i)
+    def _():
+        q = (q_ref[0].astype(jnp.float32) * scale).astype(q_ref.dtype)
+        kb = k_ref[0]
+        do = do_ref[0]
         lse = lse_ref[bh, pl.ds(i * bq, bq)][:, None]
         delta = delta_ref[bh, pl.ds(i * bq, bq)][:, None]
         s = jax.lax.dot_general(
@@ -183,10 +165,56 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             preferred_element_type=jnp.float32,
         )
         q_pos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
+        k_pos = j * bq + jax.lax.broadcasted_iota(jnp.int32, (1, bq), 1)
+        s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse)  # exact probabilities via saved logsumexp
+        dp = jax.lax.dot_general(
+            do, v_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta)
+        dq_acc[:] = dq_acc[:] + jax.lax.dot_general(
+            ds.astype(kb.dtype), kb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(j == i)
+    def _():
+        dq_ref[0] = (dq_acc[:] * scale).astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk_acc, dv_acc, *, block: int,
+                scale: float):
+    bh = pl.program_id(0)
+    j = pl.program_id(1)
+    i = pl.program_id(2)
+    ni = pl.num_programs(2)
+    bq = block
+
+    @pl.when(i == 0)
+    def _():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    @pl.when(i >= j)
+    def _():
+        q = (q_ref[0].astype(jnp.float32) * scale).astype(q_ref.dtype)
+        kb = k_ref[0]
+        vb = v_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[bh, pl.ds(i * bq, bq)][:, None]
+        delta = delta_ref[bh, pl.ds(i * bq, bq)][:, None]
+        s = jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        q_pos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
+        k_pos = j * bq + jax.lax.broadcasted_iota(jnp.int32, (1, bq), 1)
         s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
         p = jnp.exp(s - lse)
         pc = p.astype(do.dtype)
-        dv = dv + jax.lax.dot_general(
+        dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
             pc, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
@@ -195,20 +223,17 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             preferred_element_type=jnp.float32,
         )
         ds = (p * (dp - delta)).astype(q.dtype)
-        dk = dk + jax.lax.dot_general(
+        # no extra scale: q is already scaled, so ds^T @ q_scaled IS the
+        # gradient w.r.t. the unscaled k
+        dk_acc[:] = dk_acc[:] + jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        return dk, dv
 
-    dk0 = jnp.zeros((bq, hd), jnp.float32)
-    dv0 = jnp.zeros((bq, hd), jnp.float32)
-    # columns: only q blocks i >= j attend to this k block
-    dk, dv = jax.lax.fori_loop(j, nq, body, (dk0, dv0))
-    # no extra scale: the body's q is already scaled, so ds^T @ q_scaled
-    # IS the gradient w.r.t. the unscaled k
-    dk_ref[0] = dk.astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+    @pl.when(i == ni - 1)
+    def _():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
 def _bwd(q3, k3, v3, out, lse, do3, block: int, scale: float):
@@ -217,58 +242,67 @@ def _bwd(q3, k3, v3, out, lse, do3, block: int, scale: float):
     delta = jnp.sum(
         do3.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
     )  # [BH, T]
-    common_in = [
-        pl.BlockSpec((1, T, hd), lambda b, i: (b, 0, 0),
-                     memory_space=pltpu.VMEM),
-    ]
+
+    def kv_row_idx(b, i, j):  # dq grid: kv blocks clamp to the diagonal
+        return (b, jnp.minimum(i, j), 0)
+
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, block=block, scale=scale),
-        grid=(BH, nq),
+        grid=(BH, nq, nq),
         in_specs=[
-            pl.BlockSpec((1, block, hd), lambda b, i: (b, i, 0),
+            pl.BlockSpec((1, block, hd), lambda b, i, j: (b, i, 0),
                          memory_space=pltpu.VMEM),
-            common_in[0], common_in[0],
-            pl.BlockSpec((1, block, hd), lambda b, i: (b, i, 0),
+            pl.BlockSpec((1, block, hd), kv_row_idx,
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block, hd), kv_row_idx,
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block, hd), lambda b, i, j: (b, i, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec(memory_space=pltpu.VMEM),  # full lse
             pl.BlockSpec(memory_space=pltpu.VMEM),  # full delta
         ],
-        out_specs=pl.BlockSpec((1, block, hd), lambda b, i: (b, i, 0),
+        out_specs=pl.BlockSpec((1, block, hd), lambda b, i, j: (b, i, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((BH, T, hd), q3.dtype),
+        scratch_shapes=[pltpu.VMEM((block, hd), jnp.float32)],
         interpret=_interpret(),
     )(q3, k3, v3, do3, lse, delta)
+
+    def q_col_idx(b, j, i):  # dkv grid: q/do blocks clamp to the diagonal
+        return (b, jnp.maximum(i, j), 0)
+
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, block=block, scale=scale),
-        grid=(BH, nq),
+        grid=(BH, nq, nq),
         in_specs=[
-            common_in[0],
-            pl.BlockSpec((1, block, hd), lambda b, j: (b, j, 0),
+            pl.BlockSpec((1, block, hd), q_col_idx,
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block, hd), lambda b, j: (b, j, 0),
+            pl.BlockSpec((1, block, hd), lambda b, j, i: (b, j, 0),
                          memory_space=pltpu.VMEM),
-            common_in[0],
+            pl.BlockSpec((1, block, hd), lambda b, j, i: (b, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block, hd), q_col_idx,
+                         memory_space=pltpu.VMEM),
             pl.BlockSpec(memory_space=pltpu.VMEM),  # full lse
             pl.BlockSpec(memory_space=pltpu.VMEM),  # full delta
         ],
         out_specs=[
-            pl.BlockSpec((1, block, hd), lambda b, j: (b, j, 0),
+            pl.BlockSpec((1, block, hd), lambda b, j, i: (b, j, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block, hd), lambda b, j: (b, j, 0),
+            pl.BlockSpec((1, block, hd), lambda b, j, i: (b, j, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((BH, T, hd), k3.dtype),
             jax.ShapeDtypeStruct((BH, T, hd), v3.dtype),
         ],
+        scratch_shapes=[
+            pltpu.VMEM((block, hd), jnp.float32),
+            pltpu.VMEM((block, hd), jnp.float32),
+        ],
         interpret=_interpret(),
     )(q3, k3, v3, do3, lse, delta)
     return dq, dk, dv
-
-
-def _interpret() -> bool:
-    """Interpret mode off-TPU (CPU test meshes run the same program)."""
-    return jax.default_backend() != "tpu"
 
 
 # ---------------------------------------------------------------------------
@@ -286,22 +320,36 @@ def _from_bh(x, B, H):
     return x.reshape(B, H, T, hd).transpose(0, 2, 1, 3)
 
 
-# Per-program K+V VMEM budget: the whole [T, hd] K and V live on-chip
-# (double-buffered by the pipeline), so 2 * T * hd * itemsize must stay
-# well under the ~16 MB VMEM. 8 MB leaves room for the q/o/do blocks, the
-# f32 logits tile and accumulators (measured: T=8192/hd=256 at 8.4 MB
-# fails to compile; T=4096 runs 1.9x faster than the blocked kernel).
-MAX_KV_VMEM_BYTES = 8 * 1024 * 1024
+# The f32 logsumexp and delta buffers are whole-[BH, T] VMEM residents in
+# every kernel (a (1, block) tile would violate the (8, 128) tiling
+# constraint), so the VMEM ceiling is on BH * T, not T * hd: the backward
+# kernels hold both at 4 bytes each. 4 MB leaves ample room for the
+# q/kv/do blocks, the f32 logits tile, and the accumulator scratch.
+MAX_AUX_VMEM_BYTES = 4 * 1024 * 1024
 
 
 def supports(T: int, hd: int, block: int = DEFAULT_BLOCK,
-             itemsize: int = 2) -> bool:
+             itemsize: int = 2, batch_heads: int | None = None) -> bool:
     """Shapes this kernel serves: sequence divisible by the block after
-    clamping, lane-aligned head dim, K+V within the VMEM budget."""
+    clamping, lane-aligned head dim, and — when ``batch_heads`` (B*H) is
+    known — lse+delta within the VMEM budget. KV streams per block, so
+    there is no ``T*hd`` ceiling and the model dtype (``itemsize``, kept
+    for interface stability) does not matter; the aux buffers are always
+    f32."""
     b = min(block, T)
-    # strict: T=8192/hd=256 bf16 sits exactly at 8 MB and fails to compile
-    return (T % b == 0 and hd % 128 == 0
-            and 2 * T * hd * itemsize < MAX_KV_VMEM_BYTES)
+    ok = T % b == 0 and hd % 128 == 0
+    if batch_heads is not None:
+        ok = ok and 2 * 4 * batch_heads * T <= MAX_AUX_VMEM_BYTES
+    return ok
+
+
+def preferred(T: int, hd: int, batch_heads: int,
+              block: int = DEFAULT_BLOCK) -> bool:
+    """THE auto-select predicate — shared by the model and the benches so
+    the recorded kernel label can't drift from what actually ran: this
+    kernel is used iff we're on TPU and :func:`supports` holds."""
+    return (jax.default_backend() == "tpu"
+            and supports(T, hd, block, batch_heads=batch_heads))
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))

@@ -65,10 +65,14 @@ def test_single_block_sequence():
 def test_supports_gate():
     assert supports(2048, 256)
     assert supports(4096, 256)
-    assert not supports(8192, 256)  # K+V exceed the VMEM budget
+    assert supports(8192, 256)  # per-block KV DMA: no T*hd ceiling
     assert not supports(2048, 64)  # sub-lane head dim
     assert not supports(1000, 128)  # not block-divisible
     assert supports(100, 128)  # block clamps to T
+    # the REAL ceiling is BH*T: the f32 lse/delta buffers are whole-array
+    # VMEM residents, so huge batch_heads x sequence must fall back
+    assert supports(8192, 256, batch_heads=16)  # flagship T=8192 shape
+    assert not supports(32768, 256, batch_heads=64)  # 16.8 MB of aux
 
 
 def test_unsupported_shapes_raise():
