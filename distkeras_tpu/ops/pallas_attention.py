@@ -366,10 +366,11 @@ def pallas_causal_attention(q, k, v, block: int = DEFAULT_BLOCK):
 def _fwd_res(q, k, v, block):
     B, T, H, hd = q.shape
     b = min(block, T)
-    if not supports(T, hd, block):
+    if not supports(T, hd, block, batch_heads=B * H):
         raise ValueError(
-            f"pallas attention needs T % {b} == 0 and hd % 128 == 0; got "
-            f"T={T}, hd={hd} — use attention='blocked'"
+            f"pallas attention needs T % {b} == 0, hd % 128 == 0, and "
+            f"B*H*T within the aux-VMEM budget; got B*H={B * H}, T={T}, "
+            f"hd={hd} — use attention='blocked'"
         )
     scale = 1.0 / math.sqrt(hd)
     q3, k3, v3 = _to_bh(q), _to_bh(k), _to_bh(v)

@@ -14,6 +14,9 @@ axes the hardware offers:
     # 8 devices, batch x sequence x tensor (Megatron sharding):
     python examples/lm_training.py --dp 2 --sp 2 --tp 2
 
+    # 8 devices, pipeline x batch x tensor (GPipe x Megatron):
+    python examples/lm_training.py --pp 2 --dp 2 --tp 2 --microbatches 4
+
 Zero-egress: trains on a synthetic token corpus with learnable structure
 (a noisy repeating pattern — loss well below the uniform floor proves
 learning). Pass --metrics out.jsonl for per-step JSONL observability.
@@ -46,6 +49,10 @@ def main():
     ap.add_argument("--dp", type=int, default=0, help="0 = all devices")
     ap.add_argument("--sp", type=int, default=1)
     ap.add_argument("--tp", type=int, default=1)
+    ap.add_argument("--pp", type=int, default=1,
+                    help="GPipe pipeline stages (layers must divide)")
+    ap.add_argument("--microbatches", type=int, default=None,
+                    help="GPipe M per optimizer step (default 4*pp)")
     ap.add_argument("--moe", action="store_true",
                     help="use the Switch-MoE model (implied by --ep > 1)")
     ap.add_argument("--ep", type=int, default=1,
@@ -72,9 +79,12 @@ def main():
 
     moe = args.moe or args.ep > 1
     dp = args.dp or max(1, len(jax.devices()) //
-                        (args.sp * args.tp * max(args.ep, 1)))
-    axes = {"dp": dp, "sp": args.sp, "tp": args.tp, "ep": args.ep}
+                        (args.sp * args.tp * max(args.ep, 1) * args.pp))
+    axes = {"pp": args.pp, "dp": dp, "sp": args.sp, "tp": args.tp,
+            "ep": args.ep}
     axes = {k: v for k, v in axes.items() if v > 1} or {"dp": 1}
+    if args.pp > 1:
+        axes.setdefault("dp", 1)  # the pp path always names dp
     if moe:
         # the MoE mesh always carries dp and ep, size-1 or not
         axes.setdefault("dp", 1)
@@ -104,6 +114,9 @@ def main():
         model, axes=axes, batch_size=args.batch_size, num_epoch=args.epochs,
         worker_optimizer="adam", learning_rate=3e-3,
         metrics_path=args.metrics,
+        # passed through unconditionally: the trainer's own validation
+        # tells the user the flag needs a pp axis
+        microbatches=args.microbatches,
     )
     trainer.train(ds)
 

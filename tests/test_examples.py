@@ -145,3 +145,16 @@ def test_lm_training_example_moe_smoke(monkeypatch, capsys):
     )
     out = capsys.readouterr().out
     assert "tokens/sec" in out
+
+
+def test_lm_training_example_pp_smoke(monkeypatch, capsys):
+    sys.path.insert(0, "examples")
+    run_example(
+        monkeypatch, "lm_training",
+        ["lm_training.py", "--pp", "2", "--dp", "2", "--tp", "2",
+         "--microbatches", "4", "--n", "64", "--seq-len", "32",
+         "--d-model", "32", "--heads", "2", "--layers", "2",
+         "--batch-size", "16", "--epochs", "2", "--vocab", "64"],
+    )
+    out = capsys.readouterr().out
+    assert "tokens/sec" in out and "pp" in out
