@@ -15,10 +15,12 @@ halve utilization), 8 layers, vocab 8192, T=2048, bf16 compute, adamw,
 attention='standard' (auto-selects the Pallas causal-skip kernel on TPU)
 — measured as a 5-step ``lax.scan`` window per dispatch so host dispatch
 latency is amortized, with MFU from XLA's own cost analysis of a single
-step (scan bodies are counted once). NOTE: with the Pallas kernel the
-cost analysis counts ZERO flops inside the custom call, so the printed
-lm_mfu is a LOWER bound (the numerator excludes all attention math while
-the wall clock includes it); tokens/sec is the honest headline.
+step (scan bodies are counted once). With the Pallas kernel the cost
+analysis counts ZERO flops inside the custom call, so the analytically
+exact attention FLOP count (:func:`_pallas_attn_flops` — forward + Dao
+backward, causal wedge only, executed-FLOP convention) is added to the
+numerator and ``lm_mfu_method`` records that this happened: lm_mfu is a
+measurement, not a floor (VERDICT r3 next #1).
 
 Baseline: the reference (dist-keras) publishes no throughput numbers
 (BASELINE.json "published": {}). BASELINE.md's north star is ">=5x
@@ -48,6 +50,26 @@ PEAK_FLOPS = {
     "TPU v6 lite": 918e12,
     "TPU v6e": 918e12,
 }
+
+
+def _pallas_attn_flops(B, H, T, hd, layers, block):
+    """Analytic FLOPs of ONE training step's causal-skip Pallas attention
+    (forward + Dao-recompute backward), counted exactly as executed — XLA's
+    cost analysis bills ZERO FLOPs inside a custom call, so without this
+    the reported lm_mfu was a floor that excluded all attention math
+    (VERDICT r3 weak #1 / next #1).
+
+    Per (batch*head, q-block i, k-block j<=i) tile the kernels run 9
+    (block x block x hd) matmuls at 2*block^2*hd FLOPs each: 2 forward
+    (qk^T, pv), 3 in the dq kernel (s recompute, dp, dq) and 4 in the
+    dk/dv kernel (s recompute, dv, dp, dk). Each of the three kernels
+    walks only its causal wedge of nq*(nq+1)/2 tiles — the executed-FLOP
+    convention matches how XLA bills the blocked kernel (which computes
+    every masked tile it touches). Elementwise softmax math is omitted
+    (<1% of the matmul count)."""
+    b = min(block, T)
+    tiles = (T // b) * (T // b + 1) // 2
+    return layers * B * H * tiles * 9 * 2 * b * b * hd
 
 
 def _flops_per_call(jitted, *args):
@@ -139,7 +161,7 @@ def lm_bench():
     # the model's own selection predicate, so the recorded config can't
     # lie about which kernel actually ran
     kernel = ("pallas-causal"
-              if pallas_attention.preferred(T, D // H, B * H)
+              if pallas_attention.preferred(T, D // H, itemsize=2)
               else "blocked")
     out = {
         "lm_tokens_per_sec_per_chip": round(steps * B * T / dt, 1),
@@ -147,6 +169,12 @@ def lm_bench():
     }
     peak = _peak_flops()
     if flops is not None and peak is not None:
+        if kernel == "pallas-causal":
+            # exact MFU: add the custom-call FLOPs XLA can't see
+            flops += _pallas_attn_flops(
+                B, H, T, D // H, L, pallas_attention.DEFAULT_BLOCK
+            )
+            out["lm_mfu_method"] = "xla-cost-analysis+analytic-pallas-attn"
         out["lm_mfu"] = round(flops * steps / dt / peak, 4)
     return out
 

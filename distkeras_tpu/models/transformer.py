@@ -149,11 +149,11 @@ class CausalSelfAttention(nn.Module):
                 # blocked kernel computes (measured 1.6-2.4x at
                 # T=2048-8192); interpret mode off-TPU is correct but
                 # slow, so only TPU auto-selects it, via the shared
-                # predicate. batch_heads bounds the kernel's VMEM-resident
-                # f32 lse/delta buffers — use the LOCAL head count
-                # (q.shape[2]): tensor parallelism divides H by tp_size
+                # predicate
                 mode = ("pallas"
-                        if pallas_attention.preferred(T, hd, B * q.shape[2])
+                        if pallas_attention.preferred(
+                            T, hd,
+                            itemsize=jnp.dtype(self.dtype).itemsize)
                         else "blocked")
         if mode == "ring":
             from distkeras_tpu.ops.ring_attention import ring_attention

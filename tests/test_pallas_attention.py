@@ -68,11 +68,19 @@ def test_supports_gate():
     assert supports(8192, 256)  # per-block KV DMA: no T*hd ceiling
     assert not supports(2048, 64)  # sub-lane head dim
     assert not supports(1000, 128)  # not block-divisible
-    assert supports(100, 128)  # block clamps to T
-    # the REAL ceiling is BH*T: the f32 lse/delta buffers are whole-array
-    # VMEM residents, so huge batch_heads x sequence must fall back
+    # clamped block must be sublane-aligned for the dtype (ADVICE r3 #1):
+    # T=100 clamps to a 100-row block — mis-tiles when compiled
+    assert not supports(100, 128)
+    assert supports(96, 128, itemsize=2)  # 16-aligned bf16 block
+    assert supports(104, 128, itemsize=4)  # 8-aligned f32 block
+    assert not supports(104, 128, itemsize=2)
+    assert supports(96, 128, itemsize=1)  # 32-aligned int8/fp8 block
+    assert not supports(48, 128, itemsize=1)
+    # r4: lse/delta stream as blocked lane-replicated tiles, so B*H*T no
+    # longer has a VMEM ceiling — shapes the r3 cap rejected now pass
     assert supports(8192, 256, batch_heads=16)  # flagship T=8192 shape
-    assert not supports(32768, 256, batch_heads=64)  # 16.8 MB of aux
+    assert supports(32768, 256, batch_heads=64)  # r3 cap: 16.8 MB of aux
+    assert supports(4096, 256, batch_heads=128)  # B=16/T=4096 (r3 weak #4)
 
 
 def test_unsupported_shapes_raise():
