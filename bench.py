@@ -161,7 +161,9 @@ def lm_bench():
     # the model's own selection predicate, so the recorded config can't
     # lie about which kernel actually ran
     kernel = ("pallas-causal"
-              if pallas_attention.preferred(T, D // H, itemsize=2)
+              if pallas_attention.preferred(
+                  T, D // H,
+                  itemsize=jnp.dtype(model.dtype).itemsize)
               else "blocked")
     out = {
         "lm_tokens_per_sec_per_chip": round(steps * B * T / dt, 1),

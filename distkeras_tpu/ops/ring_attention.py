@@ -3,12 +3,35 @@
 The reference has no attention and no sequence parallelism (SURVEY.md §5.7);
 this is the framework's long-context capability. Algorithm (Liu, Zaheer,
 Abbeel — "Ring Attention with Blockwise Transformers"): shard the sequence
-over a mesh axis; each device holds a Q/K/V block of shape
-``[B, T/N, H, hd]``; K/V blocks rotate around the ring with
-``lax.ppermute`` over ICI while each device accumulates its queries' output
-with a streaming (flash-style) log-sum-exp softmax. Compute/communication
-overlap is left to XLA's async collective scheduling; per-step work is one
-``[Tq, Tk]`` block matmul per head — MXU-shaped.
+over a mesh axis; each device holds a Q/K/V block; K/V blocks rotate around
+the ring with ``lax.ppermute`` over ICI while each device accumulates its
+queries' output with a streaming (flash-style) log-sum-exp softmax.
+
+Two implementations (VERDICT r3 weak #2 / next #2):
+
+- **zigzag** (causal default): the TPU-first redesign. The r3 kernel
+  computed a full ``[B, H, Tq, Tk]`` f32 logits tensor per ring step and
+  masked it — for causal attention roughly half the ring steps were pure
+  waste, memory was O(T_local^2), and low shards did all the work while
+  high shards idled (lock-step ``ppermute`` syncs everyone to the slowest,
+  so per-device skipping alone buys NO wall clock). The fix is the zigzag
+  sequence layout (as used for Llama-3-style context parallelism): split
+  the global sequence into 2N chunks; device d holds chunk d (early) and
+  chunk 2N-1-d (late). Then at every ring step EVERY device has exactly
+  two fully-unmasked chunk-pair attentions to do — (late q, early kv)
+  always, plus (early q, early kv) when the source shard is older or
+  (late q, late kv) when it is newer — so the causal-skip win (~2x fewer
+  executed FLOPs) translates into balanced wall clock, with zero masking
+  outside the two local diagonal chunks of step 0. Chunk pairs stream
+  through a blocked flash inner loop (O(C*block) memory, bf16 matmuls on
+  the MXU, f32 accumulation), and each ring step is ``jax.checkpoint``ed
+  so autodiff recomputes instead of stashing per-step logits. The layout
+  shuffle is internal: one ppermute pair converts contiguous shards to
+  zigzag on entry and back on exit, so callers (the model's 'ring' mode,
+  the sp trainers, the positional encodings) keep contiguous semantics.
+
+- **naive** (non-causal, and fallback for shapes the zigzag gate
+  rejects): the r3 rotate-and-mask kernel, kept verbatim.
 
 Must be called inside ``shard_map`` (or another context binding
 ``axis_name``) with Q/K/V already sharded along the sequence dimension.
@@ -16,12 +39,14 @@ Must be called inside ``shard_map`` (or another context binding
 
 from __future__ import annotations
 
+import functools
 import math
 
 import jax
 import jax.numpy as jnp
 
 _NEG_INF = -1e30
+DEFAULT_KV_BLOCK = 512
 
 
 def ring_attention(
@@ -30,15 +55,20 @@ def ring_attention(
     v: jnp.ndarray,
     axis_name: str,
     causal: bool = True,
+    impl: str = "auto",
 ) -> jnp.ndarray:
     """Exact attention over a sequence sharded on ``axis_name``.
 
     Args:
-      q, k, v: ``[B, T_local, H, head_dim]`` — this device's sequence shard.
+      q, k, v: ``[B, T_local, H, head_dim]`` — this device's CONTIGUOUS
+        sequence shard (shard index × T_local + local offset = global
+        position). Any zigzag re-layout is internal.
       axis_name: mesh axis the sequence is sharded over.
-      causal: apply a causal mask using *global* positions (shard index ×
-        T_local + local offset), so semantics match unsharded causal
-        attention exactly.
+      causal: apply a causal mask using global positions, so semantics
+        match unsharded causal attention exactly.
+      impl: ``'auto'`` (zigzag when causal and the shapes allow, else
+        naive), ``'zigzag'``, or ``'naive'`` — pinned impls raise/ignore
+        per their gates; tests and benches use them to compare.
 
     Returns:
       ``[B, T_local, H, head_dim]`` in ``q.dtype``.
@@ -51,6 +81,210 @@ def ring_attention(
             "ring_attention requires a statically-known axis size; call it "
             "inside shard_map over a Mesh axis."
         ) from e
+    T_local = q.shape[1]
+    if impl not in ("auto", "zigzag", "naive"):
+        raise ValueError(
+            f"Unknown ring impl '{impl}'. Known: auto, zigzag, naive"
+        )
+    zig_ok = causal and _zigzag_supports(T_local)
+    if impl == "zigzag" and not zig_ok:
+        raise ValueError(
+            "zigzag ring attention needs causal=True and an even T_local "
+            "whose half is block-divisible; use impl='auto' to fall back"
+        )
+    if impl in ("auto", "zigzag") and zig_ok:
+        return _ring_zigzag(q, k, v, axis_name, num_shards)
+    return _ring_naive(q, k, v, axis_name, num_shards, causal)
+
+
+# ---------------------------------------------------------------------------
+# zigzag implementation
+# ---------------------------------------------------------------------------
+
+
+def _zigzag_supports(T_local: int) -> bool:
+    C = T_local // 2
+    if T_local % 2 or C == 0:
+        return False
+    return C <= DEFAULT_KV_BLOCK or C % DEFAULT_KV_BLOCK == 0
+
+
+def _tree_where(pred, a, b):
+    return jax.tree.map(lambda x, y: jnp.where(pred, x, y), a, b)
+
+
+def _varying_zeros(q, shapes_fills, axis_name):
+    """Online-softmax accumulator init carrying q's full varying-manual-
+    axes set — which may span more mesh axes than the ring (e.g. batch
+    over 'dp' too) — or scan rejects the carry types. The constants are
+    pcast rather than derived from q data: a data-derived zero would let
+    one non-finite element of q NaN-poison every accumulator."""
+    vma = tuple(sorted(getattr(jax.typeof(q), "vma", None) or (axis_name,)))
+    return tuple(
+        jax.lax.pcast(jnp.full(shape, fill, jnp.float32), vma, to="varying")
+        for shape, fill in shapes_fills
+    )
+
+
+def _attend(stats, qf, kc, vc, *, causal: bool, bk: int):
+    """Streamed attention of one chunk pair, folded into running online-
+    softmax stats ``(o [B,C,H,hd] f32, m [B,H,C] f32, l [B,H,C] f32)``.
+
+    ``qf`` is pre-scaled, model dtype; matmuls run in the model dtype on
+    the MXU with f32 accumulation. ``causal`` masks LOCAL positions (the
+    only masked pairs are a chunk against itself on the diagonal)."""
+    o, m, l = stats
+    B, C, H, hd = qf.shape
+    nb = C // bk
+    kb = kc.reshape(B, nb, bk, H, hd).transpose(1, 0, 2, 3, 4)
+    vb = vc.reshape(B, nb, bk, H, hd).transpose(1, 0, 2, 3, 4)
+    q_pos = jnp.arange(C)
+
+    def step(carry, blk):
+        o, m, l, i = carry
+        kcb, vcb = blk
+        s = jnp.einsum(
+            "bqhd,bkhd->bhqk", qf, kcb, preferred_element_type=jnp.float32
+        )
+        if causal:
+            k_pos = i * bk + jnp.arange(bk)
+            s = jnp.where(
+                (q_pos[:, None] >= k_pos[None, :])[None, None], s, _NEG_INF
+            )
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        corr = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[..., None])
+        l_new = l * corr + p.sum(axis=-1)
+        o_new = o * corr.transpose(0, 2, 1)[..., None] + jnp.einsum(
+            "bhqk,bkhd->bqhd", p.astype(qf.dtype), vcb,
+            preferred_element_type=jnp.float32,
+        )
+        return (o_new, m_new, l_new, i + 1), None
+
+    (o, m, l, _), _ = jax.lax.scan(step, (o, m, l, jnp.int32(0)), (kb, vb))
+    return o, m, l
+
+
+def _zig_perms(N: int):
+    """Static ppermute pairs for contiguous→zigzag: contiguous device d
+    holds chunks (2d, 2d+1) in units of C = T_local/2; zigzag device r
+    wants (r, 2N-1-r). Chunk c lives on zigzag device min(c, 2N-1-c),
+    and the two send streams (first/second local half) are each a
+    bijection over devices."""
+    dst0 = [2 * d if 2 * d < N else 2 * N - 1 - 2 * d for d in range(N)]
+    dst1 = [2 * d + 1 if 2 * d + 1 < N else 2 * N - 2 - 2 * d
+            for d in range(N)]
+    perm0 = [(d, dst0[d]) for d in range(N)]
+    perm1 = [(d, dst1[d]) for d in range(N)]
+    inv0 = [(dst0[d], d) for d in range(N)]
+    inv1 = [(dst1[d], d) for d in range(N)]
+    return perm0, perm1, inv0, inv1
+
+
+def _ring_zigzag(q, k, v, axis_name, N):
+    B, T_local, H, hd = q.shape
+    C = T_local // 2
+    bk = min(DEFAULT_KV_BLOCK, C)
+    scale = 1.0 / math.sqrt(hd)
+    my = jax.lax.axis_index(axis_name)
+    perm0, perm1, inv0, inv1 = _zig_perms(N)
+    even = (my % 2) == 0
+
+    def to_zig(x):
+        a, b = x[:, :C], x[:, C:]
+        r0 = jax.lax.ppermute(a, axis_name, perm0)
+        r1 = jax.lax.ppermute(b, axis_name, perm1)
+        # received chunk ids: r0 carries an even chunk (2s), r1 an odd
+        # one; the early chunk id equals the device index, so it arrived
+        # on r0 iff that index is even
+        early = jnp.where(even, r0, r1)
+        late = jnp.where(even, r1, r0)
+        return early, late
+
+    qe, ql = to_zig(q)
+    ke, kl = to_zig(k)
+    ve, vl = to_zig(v)
+    qe = (qe.astype(jnp.float32) * scale).astype(q.dtype)
+    ql = (ql.astype(jnp.float32) * scale).astype(q.dtype)
+
+    zero_stats = lambda: _varying_zeros(  # noqa: E731
+        q,
+        (((B, C, H, hd), 0.0), ((B, H, C), _NEG_INF), ((B, H, C), 0.0)),
+        axis_name,
+    )
+
+    attend = functools.partial(_attend, bk=bk)
+
+    # step 0 — the only masked work: both local diagonal chunks, plus the
+    # always-full (late q, early kv) pair
+    @jax.checkpoint
+    def local_step(qe, ql, ke, kl, ve, vl):
+        es = attend(zero_stats(), qe, ke, ve, causal=True)
+        ls = attend(zero_stats(), ql, ke, ve, causal=False)
+        ls = attend(ls, ql, kl, vl, causal=True)
+        return es, ls
+
+    es, ls = local_step(qe, ql, ke, kl, ve, vl)
+
+    if N > 1:
+        rot = [(j, (j + 1) % N) for j in range(N)]
+
+        @jax.checkpoint
+        def pair_step(es, ls, kst, vst, src):
+            # source shard src = (my - i) mod N, never == my here.
+            # Exactly two UNMASKED chunk pairs per step (the zigzag
+            # balance): (late q, early kv) always; plus early q against
+            # early kv when my > src, else late q against late kv.
+            ke, kl = kst[0], kst[1]
+            ve, vl = vst[0], vst[1]
+            ls = attend(ls, ql, ke, ve, causal=False)
+            use_early = my > src
+            q_sel = jnp.where(use_early, qe, ql)
+            k_sel = jnp.where(use_early, ke, kl)
+            v_sel = jnp.where(use_early, ve, vl)
+            st = _tree_where(use_early, es, ls)
+            st = attend(st, q_sel, k_sel, v_sel, causal=False)
+            es = _tree_where(use_early, st, es)
+            ls = _tree_where(use_early, ls, st)
+            return es, ls
+
+        def step(carry, i):
+            es, ls, kst, vst = carry
+            # early/late halves ride one stacked buffer per tensor, so a
+            # rotation is 2 collectives (same as the naive ring), not 4
+            kst = jax.lax.ppermute(kst, axis_name, rot)
+            vst = jax.lax.ppermute(vst, axis_name, rot)
+            src = jnp.mod(my - i, N)
+            es, ls = pair_step(es, ls, kst, vst, src)
+            return (es, ls, kst, vst), None
+
+        (es, ls, *_), _ = jax.lax.scan(
+            step,
+            (es, ls, jnp.stack([ke, kl]), jnp.stack([ve, vl])),
+            jnp.arange(1, N),
+        )
+
+    def finalize(stats):
+        o, m, l = stats
+        denom = jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
+        return (o / denom).astype(q.dtype)
+
+    oe, ol = finalize(es), finalize(ls)
+    # exit: invert the entry shuffle (send back on the stream each chunk
+    # arrived on, through the inverted perms)
+    s0 = jnp.where(even, oe, ol)
+    s1 = jnp.where(even, ol, oe)
+    a = jax.lax.ppermute(s0, axis_name, inv0)
+    b = jax.lax.ppermute(s1, axis_name, inv1)
+    return jnp.concatenate([a, b], axis=1)
+
+
+# ---------------------------------------------------------------------------
+# naive implementation (r3 kernel): rotate and mask
+# ---------------------------------------------------------------------------
+
+
+def _ring_naive(q, k, v, axis_name, num_shards, causal):
     my_shard = jax.lax.axis_index(axis_name)
     B, T_local, H, hd = q.shape
     scale = 1.0 / math.sqrt(hd)
@@ -80,17 +314,12 @@ def ring_attention(
         vc = jax.lax.ppermute(vc, axis_name, perm)
         return (o_new, m_new, l_new, kc, vc), None
 
-    # The accumulators are device-varying (each shard computes its own). They
-    # must carry the same varying-manual-axes type as q — which may vary over
-    # more mesh axes than the ring axis (e.g. batch over 'dp' too) — or scan
-    # rejects the carry types. pcast the constants to q's full vma set (a
-    # data-derived zero would let one non-finite element of q NaN-poison
-    # every accumulator).
-    vma = tuple(sorted(getattr(jax.typeof(q), "vma", None) or (axis_name,)))
-    cast = lambda a: jax.lax.pcast(a, vma, to="varying")  # noqa: E731
-    o0 = cast(jnp.zeros((B, T_local, H, hd), jnp.float32))
-    m0 = cast(jnp.full((B, H, T_local), _NEG_INF, jnp.float32))
-    l0 = cast(jnp.zeros((B, H, T_local), jnp.float32))
+    o0, m0, l0 = _varying_zeros(
+        q,
+        (((B, T_local, H, hd), 0.0), ((B, H, T_local), _NEG_INF),
+         ((B, H, T_local), 0.0)),
+        axis_name,
+    )
     (o, _, l, _, _), _ = jax.lax.scan(
         step, (o0, m0, l0, k, v), jnp.arange(num_shards)
     )

@@ -155,6 +155,12 @@ def make_pp_lm_train_step(model, optimizer, mesh: Mesh,
                 def body(x, bp):
                     return block_mod.apply({"params": bp}, x), None
 
+                if getattr(model, "remat", "none") == "block":
+                    # per-layer recompute: the scan then stashes only the
+                    # block inputs per tick, not every block internal —
+                    # the pp path compounds activation residency across
+                    # M + pp - 1 ticks, so this is where remat matters most
+                    body = jax.checkpoint(body)
                 x, _ = jax.lax.scan(body, x, p["blocks"])
                 return x
 

@@ -1,0 +1,100 @@
+"""Activation checkpointing (remat='block') changes memory, never math:
+outputs and gradients must match the non-remat model exactly (same ops,
+recomputed). VERDICT r3 next #3."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distkeras_tpu.models import get_model
+
+
+def _lm(remat, **over):
+    kw = dict(vocab_size=128, d_model=128, num_heads=2, num_layers=2,
+              max_len=256, dtype=jnp.float32, attention="blocked",
+              remat=remat)
+    kw.update(over)
+    return get_model("transformer_lm", **kw)
+
+
+def _toks(B=2, T=256, V=128, seed=0):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.integers(0, V, size=(B, T)), jnp.int32)
+
+
+def test_remat_outputs_and_grads_match():
+    toks = _toks()
+    base = _lm("none")
+    remat = _lm("block")
+    params = base.init(jax.random.PRNGKey(0), toks)
+
+    def loss(model):
+        def f(p):
+            logits = model.apply(p, toks)
+            return jnp.mean(
+                jax.nn.log_softmax(logits)[..., 0].astype(jnp.float32) ** 2
+            )
+        return f
+
+    l0, g0 = jax.value_and_grad(loss(base))(params)
+    l1, g1 = jax.value_and_grad(loss(remat))(params)
+    np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+    for (p0, a), (p1, b) in zip(
+        jax.tree_util.tree_leaves_with_path(g0),
+        jax.tree_util.tree_leaves_with_path(g1),
+    ):
+        assert p0 == p1
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6,
+            err_msg=str(p0),
+        )
+
+
+def test_remat_param_tree_identical():
+    """Checkpoints are interchangeable: remat never alters the tree."""
+    toks = _toks()
+    p0 = _lm("none").init(jax.random.PRNGKey(1), toks)
+    p1 = _lm("block").init(jax.random.PRNGKey(1), toks)
+    assert jax.tree_util.tree_structure(p0) == jax.tree_util.tree_structure(p1)
+    for a, b in zip(jax.tree.leaves(p0), jax.tree.leaves(p1)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_remat_unknown_policy_raises():
+    with pytest.raises(ValueError, match="remat"):
+        _lm("everything").init(jax.random.PRNGKey(0), _toks())
+
+
+def test_remat_pp_step_matches_plain():
+    """Pipeline path honors model.remat and stays exact vs the dp-only
+    trajectory (same optimizer step on the same rows)."""
+    import optax
+    from distkeras_tpu.parallel.mesh import make_mesh
+    from distkeras_tpu.parallel.pipeline import (
+        make_pp_lm_train_step, to_pipeline_params,
+    )
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 devices")
+    toks = _toks(B=4, T=64)
+
+    def run(remat):
+        model = _lm(remat, max_len=64, attention="dense")
+        params = model.init(jax.random.PRNGKey(2), toks)
+        opt = optax.sgd(0.1)
+        mesh = make_mesh({"pp": 2, "dp": 1})
+        step = make_pp_lm_train_step(model, opt, mesh, params)
+        pp_params = to_pipeline_params(params, model.num_layers)
+        state = opt.init(pp_params)
+        mb = toks.reshape(2, 2, 64)  # M=2 microbatches
+        pp_params, state, loss = step(pp_params, state, mb)
+        return float(loss), jax.tree.leaves(pp_params)
+
+    l0, p0 = run("none")
+    l1, p1 = run("block")
+    np.testing.assert_allclose(l0, l1, rtol=1e-6)
+    for a, b in zip(p0, p1):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+        )
