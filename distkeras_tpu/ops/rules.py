@@ -196,15 +196,27 @@ def adag_commit(center: Pytree, delta: Pytree, num_workers: int) -> Pytree:
 # ---------------------------------------------------------------------------
 
 def allreduce_mean_delta(delta: Pytree, axis_name: str) -> Pytree:
-    """Mean of per-device deltas over a mesh axis — the SPMD form of
-    ADAG/DOWNPOUR commits when every device commits each window in lock-step.
+    """Mean of per-device deltas over a mesh axis — the SPMD form of the
+    ADAG commit when every device commits each window in lock-step.
 
     ``psum(delta)/axis_size == sum_i delta_i / N`` which is exactly
     :func:`adag_commit` applied once per device. Must be called inside
-    ``shard_map``/``pmap`` with ``axis_name`` bound.
+    ``shard_map``/``pmap`` with ``axis_name`` bound. Production caller:
+    ``ADAG(spmd=True)`` (trainers._train_lockstep_spmd).
     """
     n = jax.lax.psum(1, axis_name)
     return jax.tree.map(lambda d: jax.lax.psum(d, axis_name) / n, delta)
+
+
+def allreduce_sum_delta(delta: Pytree, axis_name: str) -> Pytree:
+    """Sum of per-device deltas over a mesh axis — the SPMD form of the
+    DOWNPOUR commit: the reference's DeltaParameterServer adds each
+    worker's delta at full strength (reference: parameter_servers.py ·
+    DeltaParameterServer.handle_commit, ``center += delta``), so a
+    lock-step window where all N workers commit applies the straight sum.
+    Production caller: ``DOWNPOUR(spmd=True)``.
+    """
+    return jax.tree.map(lambda d: jax.lax.psum(d, axis_name), delta)
 
 
 def allreduce_easgd_round(worker: Pytree, center: Pytree, alpha, axis_name: str):

@@ -744,20 +744,67 @@ class AsynchronousDistributedTrainer(DistributedTrainer):
         return self._parallelism_factor
 
 
-class DOWNPOUR(AsynchronousDistributedTrainer):
+class _DeltaFamilySpmdMixin:
+    """``spmd=True`` engine for the windowed delta-commit algorithms
+    (VERDICT r3 next #6): W local steps per device, then one lock-step
+    commit of every worker's delta inside the jitted window —
+    DOWNPOUR sums deltas (:func:`rules.allreduce_sum_delta`, the
+    DeltaParameterServer semantics), ADAG means them
+    (:func:`rules.allreduce_mean_delta`) — and every worker re-pulls the
+    new center, exactly the reference's push-then-pull. Equivalent to the
+    host PS engine under a deterministic pull-all/commit-all schedule
+    (tested against the PS classes driven directly). The true-async
+    staleness semantics remain the default engine's job; spmd trades them
+    for single-dispatch windows over ICI."""
+
+    SPMD_ENGINE = ""  # subclass sets, e.g. 'downpour-spmd'
+
+    def __init__(self, *args, spmd: bool = False, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.spmd = spmd
+
+    def _spmd_round(self, worker, center):
+        delta = rules.tree_sub(worker, center)
+        center = rules.tree_add(center, self._spmd_reduce(delta))
+        # every worker pulls the committed center (reference: workers.py
+        # push-then-pull at each communication_window boundary); the pull
+        # is pcast device-varying so the engine's dp out_spec accepts it
+        pulled = jax.tree.map(
+            lambda c: jax.lax.pcast(c, ("dp",), to="varying"), center
+        )
+        return pulled, center
+
+    def _train(self, dataset, shuffle: bool = False) -> Model:
+        if getattr(self, "spmd", False):
+            return _train_lockstep_spmd(
+                self, dataset, shuffle, engine=self.SPMD_ENGINE,
+                round_fn=self._spmd_round,
+            )
+        return super()._train(dataset, shuffle)
+
+
+class DOWNPOUR(_DeltaFamilySpmdMixin, AsynchronousDistributedTrainer):
     """Dean et al. 2012 (reference: trainers.py · DOWNPOUR)."""
 
     WORKER_CLS = workers_mod.DOWNPOURWorker
+    SPMD_ENGINE = "downpour-spmd"
+
+    def _spmd_reduce(self, delta):
+        return rules.allreduce_sum_delta(delta, "dp")
 
     def allocate_parameter_server(self):
         return ps_mod.DeltaParameterServer(self.params)
 
 
-class ADAG(AsynchronousDistributedTrainer):
+class ADAG(_DeltaFamilySpmdMixin, AsynchronousDistributedTrainer):
     """Asynchronous distributed adaptive gradients — the reference's
     recommended default (reference: trainers.py · ADAG)."""
 
     WORKER_CLS = workers_mod.ADAGWorker
+    SPMD_ENGINE = "adag-spmd"
+
+    def _spmd_reduce(self, delta):
+        return rules.allreduce_mean_delta(delta, "dp")
 
     def allocate_parameter_server(self):
         # _ps_num_workers is the global population under multi-host runs
@@ -862,197 +909,245 @@ class EASGD(SynchronousDistributedTrainer):
 
     def _train(self, dataset, shuffle: bool = False) -> Model:
         if self.spmd:
-            return self._train_spmd(dataset, shuffle)
+            alpha = self.elastic_lr * self.rho
+            return _train_lockstep_spmd(
+                self, dataset, shuffle, engine="easgd-spmd",
+                round_fn=lambda w, c: rules.allreduce_easgd_round(
+                    w, c, alpha, "dp"
+                ),
+            )
         return super()._train(dataset, shuffle)
 
-    def _train_spmd(self, dataset: PartitionedDataset,
-                    shuffle: bool = False) -> Model:
-        import warnings
 
-        from distkeras_tpu.parallel.mesh import default_mesh
-        from jax.sharding import NamedSharding
+# integer stamps for the lock-step checkpoint header (orbax trees don't
+# carry strings); 0 = unstamped legacy checkpoint, accepted silently
+_SPMD_ENGINE_IDS = {"easgd-spmd": 1, "downpour-spmd": 2, "adag-spmd": 3}
 
-        if jax.process_count() > 1:
-            raise NotImplementedError(
-                "EASGD(spmd=True) is single-process (one mesh per host); "
-                "multi-host elastic averaging uses the host-barrier "
-                "engine over the DCN PS service (spmd=False)"
-            )
-        if shuffle:
-            dataset = dataset.shuffle(seed=self.seed)
-        self.ensure_params(dataset)
-        mesh = default_mesh(self.num_workers)
-        n_dev = mesh.devices.size
-        alpha = self.elastic_lr * self.rho
 
-        optimizer = get_optimizer(self.worker_optimizer, self.learning_rate)
-        loss_fn = get_loss(self.loss)
-        metric_fns = resolve_metrics(self.metrics)
-        apply_fn = self.model.apply
+def _train_lockstep_spmd(self, dataset: PartitionedDataset, shuffle: bool,
+                         engine: str, round_fn) -> Model:
+    """Shared lock-step SPMD engine for the windowed PS algorithms
+    (EASGD/DOWNPOUR/ADAG with ``spmd=True``): every worker is a mesh
+    device, worker params/opt-state live sharded over ``dp``, the center
+    is replicated, and a whole window — W local steps plus the algorithm's
+    commit ``round_fn(stacked_workers, center) -> (workers, center)`` —
+    is ONE jitted ``shard_map`` dispatch with the exchange riding ICI.
 
-        # worker i's partition becomes device i's batch stream: batch each
-        # partition, truncate to the shortest (lock-step needs equal step
-        # counts; the host-barrier engine instead shrinks its barrier), and
-        # interleave so global batch g carries worker i's rows at slice i
-        parts = dataset.repartition(n_dev)
-        per_worker = [
-            workers_mod.batch_partition(
-                parts.partition(i), self.features_col, self.label_col,
-                self.batch_size,
-            )
-            for i in range(n_dev)
-        ]
-        n_b = min(len(xb) for xb, _ in per_worker)
-        dropped = sum(len(xb) - n_b for xb, _ in per_worker)
-        if dropped:
-            warnings.warn(
-                f"EASGD(spmd): lock-step truncated {dropped} batches "
-                f"across {n_dev} workers (shortest partition has "
-                f"{n_b}); repartition for equal sizes to keep them",
-                RuntimeWarning,
-            )
-        # [n_b, feed_dev*B, ...]: concat worker slices per global batch
-        xb = np.concatenate(
-            [xw[:n_b] for xw, _ in per_worker], axis=1
+    ``self`` is the trainer (kept as the parameter name so the engine
+    reads like the method it was extracted from)."""
+    import warnings
+
+    from distkeras_tpu.parallel.mesh import default_mesh
+    from jax.sharding import NamedSharding
+
+    if jax.process_count() > 1:
+        raise NotImplementedError(
+            f"{engine} is single-process (one mesh per host); multi-host "
+            "runs use the host/DCN PS service engine (spmd=False)"
         )
-        yb = np.concatenate(
-            [yw[:n_b] for _, yw in per_worker], axis=1
+    if shuffle:
+        dataset = dataset.shuffle(seed=self.seed)
+    self.ensure_params(dataset)
+    mesh = default_mesh(self.num_workers)
+    n_dev = mesh.devices.size
+
+    optimizer = get_optimizer(self.worker_optimizer, self.learning_rate)
+    loss_fn = get_loss(self.loss)
+    metric_fns = resolve_metrics(self.metrics)
+    apply_fn = self.model.apply
+
+    # worker i's partition becomes device i's batch stream: batch each
+    # partition, truncate to the shortest (lock-step needs equal step
+    # counts; the host-barrier engine instead shrinks its barrier), and
+    # interleave so global batch g carries worker i's rows at slice i
+    parts = dataset.repartition(n_dev)
+    per_worker = [
+        workers_mod.batch_partition(
+            parts.partition(i), self.features_col, self.label_col,
+            self.batch_size,
         )
+        for i in range(n_dev)
+    ]
+    n_b = min(len(xb) for xb, _ in per_worker)
+    dropped = sum(len(xb) - n_b for xb, _ in per_worker)
+    if dropped:
+        warnings.warn(
+            f"{engine}: lock-step truncated {dropped} batches "
+            f"across {n_dev} workers (shortest partition has "
+            f"{n_b}); repartition for equal sizes to keep them",
+            RuntimeWarning,
+        )
+    # [n_b, feed_dev*B, ...]: concat worker slices per global batch
+    xb = np.concatenate(
+        [xw[:n_b] for xw, _ in per_worker], axis=1
+    )
+    yb = np.concatenate(
+        [yw[:n_b] for _, yw in per_worker], axis=1
+    )
 
-        W = self.communication_window
+    W = self.communication_window
 
-        def device_window(worker, opt_state, center, xs, ys):
-            # worker/opt_state arrive dp-sharded with a leading axis of 1
-            # (this device's slice); squeeze it for the step math
-            worker = jax.tree.map(lambda x: x[0], worker)
-            opt_state = jax.tree.map(lambda x: x[0], opt_state)
+    def device_window(worker, opt_state, center, xs, ys):
+        # worker/opt_state arrive dp-sharded with a leading axis of 1
+        # (this device's slice); squeeze it for the step math
+        worker = jax.tree.map(lambda x: x[0], worker)
+        opt_state = jax.tree.map(lambda x: x[0], opt_state)
 
-            def one(carry, batch):
-                p, s = carry
-                x, y = batch
+        def one(carry, batch):
+            p, s = carry
+            x, y = batch
 
-                def objective(pp):
-                    logits = apply_fn(pp, x)
-                    return loss_fn(logits, y), logits
+            def objective(pp):
+                logits = apply_fn(pp, x)
+                return loss_fn(logits, y), logits
 
-                (loss, logits), grads = jax.value_and_grad(
-                    objective, has_aux=True)(p)
-                updates, s = optimizer.update(grads, s, p)
-                p = optax.apply_updates(p, updates)
-                out = {"loss": loss}
-                for name, fn in metric_fns:
-                    out[name] = fn(logits, y)
-                return (p, s), out
+            (loss, logits), grads = jax.value_and_grad(
+                objective, has_aux=True)(p)
+            updates, s = optimizer.update(grads, s, p)
+            p = optax.apply_updates(p, updates)
+            out = {"loss": loss}
+            for name, fn in metric_fns:
+                out[name] = fn(logits, y)
+            return (p, s), out
 
-            (worker, opt_state), ms = jax.lax.scan(
-                one, (worker, opt_state), (xs, ys)
+        (worker, opt_state), ms = jax.lax.scan(
+            one, (worker, opt_state), (xs, ys)
+        )
+        worker, center = round_fn(worker, center)
+        # re-lead every per-device output so the dp out_spec stacks
+        # them back to [n_dev, ...] ([n_dev, W] for the metrics)
+        lead = jax.tree.map(lambda x: x[None], worker)
+        lead_s = jax.tree.map(lambda x: x[None], opt_state)
+        ms = jax.tree.map(lambda x: x[None], ms)
+        return lead, lead_s, center, ms
+
+    window_step = jax.jit(
+        shard_map(
+            device_window,
+            mesh=mesh,
+            in_specs=(P("dp"), P("dp"), P(), P(None, "dp"), P(None, "dp")),
+            out_specs=(P("dp"), P("dp"), P(), P("dp")),
+        )
+    )
+
+    center = self.params
+    # every worker starts from the center (reference: workers pull the
+    # initial center before their first round)
+    worker = jax.tree.map(
+        lambda x: np.broadcast_to(np.asarray(x), (n_dev,) + x.shape),
+        center,
+    )
+    opt0 = optimizer.init(self.params)
+    opt_state = jax.tree.map(
+        lambda x: np.broadcast_to(np.asarray(x), (n_dev,) + np.shape(x)),
+        opt0,
+    )
+
+    # checkpoints carry center AND the stacked per-worker state (params
+    # + optimizer moments) so a resume is EXACT: restoring only the
+    # center would pair each worker's surviving momentum with params it
+    # was never computed for. The engine/worker-count stamp makes a
+    # cross-engine or resized resume fail loudly (ADVICE r3 #4): the
+    # host-barrier engines write a different opt_state layout, and a
+    # different worker count changes the stacked leading axis.
+    start_epoch = 0
+    if self.checkpointer is not None:
+        like = {
+            "params": center,
+            "opt_state": {
+                "worker": jax.tree.map(np.asarray, worker),
+                "opt": jax.tree.map(np.asarray, opt_state),
+            },
+            "extra": {"epoch": 0, "engine_id": 0, "workers": 0},
+        }
+        try:
+            ck_step, state = self.checkpointer.restore(like=like)
+        except ValueError:
+            # pre-stamp checkpoint: its extra tree lacks engine_id/workers
+            # and orbax refuses the structure mismatch — retry with the
+            # legacy template and accept it unstamped
+            like["extra"] = {"epoch": 0}
+            ck_step, state = self.checkpointer.restore(like=like)
+        if state is not None:
+            saved_id = int(state["extra"].get("engine_id", 0))
+            saved_workers = int(state["extra"].get("workers", 0))
+            if saved_id and saved_id != _SPMD_ENGINE_IDS[engine]:
+                names = {v: k for k, v in _SPMD_ENGINE_IDS.items()}
+                raise ValueError(
+                    "checkpoint was written by engine "
+                    f"'{names.get(saved_id, saved_id)}' but this trainer "
+                    f"runs '{engine}' — their state layouts are "
+                    "incompatible; resume with the matching trainer/spmd "
+                    "flag or point at a fresh directory"
+                )
+            if saved_workers and saved_workers != n_dev:
+                raise ValueError(
+                    f"checkpoint carries {saved_workers} stacked workers "
+                    f"but this run has {n_dev} — per-worker state cannot "
+                    "be re-sliced; resume with num_workers="
+                    f"{saved_workers} or start fresh"
+                )
+            center = state["params"]
+            start_epoch = int(state["extra"].get("epoch", ck_step))
+            if state["opt_state"]:
+                worker = state["opt_state"]["worker"]
+                opt_state = state["opt_state"]["opt"]
+
+    batch_sharding = NamedSharding(mesh, P(None, "dp"))
+
+    def put_feed(arr):
+        return jax.device_put(arr, batch_sharding)
+
+    # windows: full W-batch groups + one tail group (its own compile)
+    groups = [(s, min(s + W, n_b)) for s in range(0, n_b, W)]
+    staged = xb.nbytes + yb.nbytes <= self.stage_limit_bytes
+    if staged:
+        xb_d, yb_d = put_feed(xb), put_feed(yb)
+
+    history_per_worker: List[History] = [[] for _ in range(n_dev)]
+    for epoch in range(start_epoch, self.num_epoch):
+        epoch_ms = []
+        for s, e in groups:
+            if staged:
+                xw, yw = xb_d[s:e], yb_d[s:e]
+            else:
+                xw, yw = put_feed(xb[s:e]), put_feed(yb[s:e])
+            worker, opt_state, center, ms = window_step(
+                worker, opt_state, center, xw, yw
             )
-            worker, center = rules.allreduce_easgd_round(
-                worker, center, alpha, "dp"
-            )
-            # re-lead every per-device output so the dp out_spec stacks
-            # them back to [n_dev, ...] ([n_dev, W] for the metrics)
-            lead = jax.tree.map(lambda x: x[None], worker)
-            lead_s = jax.tree.map(lambda x: x[None], opt_state)
-            ms = jax.tree.map(lambda x: x[None], ms)
-            return lead, lead_s, center, ms
-
-        window_step = jax.jit(
-            shard_map(
-                device_window,
-                mesh=mesh,
-                in_specs=(P("dp"), P("dp"), P(), P(None, "dp"), P(None, "dp")),
-                out_specs=(P("dp"), P("dp"), P(), P("dp")),
-            )
-        )
-
-        center = self.params
-        # every worker starts from the center (reference: workers pull the
-        # initial center before their first round)
-        worker = jax.tree.map(
-            lambda x: np.broadcast_to(np.asarray(x), (n_dev,) + x.shape),
-            center,
-        )
-        opt0 = optimizer.init(self.params)
-        opt_state = jax.tree.map(
-            lambda x: np.broadcast_to(np.asarray(x), (n_dev,) + np.shape(x)),
-            opt0,
-        )
-
-        # checkpoints carry center AND the stacked per-worker state (params
-        # + optimizer moments) so a resume is EXACT: restoring only the
-        # center would pair each worker's surviving momentum with params it
-        # was never computed for
-        start_epoch = 0
+            epoch_ms.append(ms)
+        for ms in epoch_ms:
+            ms = {k: np.asarray(v) for k, v in ms.items()}
+            steps = next(iter(ms.values())).shape[1]
+            for w in range(n_dev):
+                rows = [
+                    {k: float(v[w, t]) for k, v in ms.items()}
+                    for t in range(steps)
+                ]
+                history_per_worker[w].extend(rows)
+                if self.metrics_writer is not None:
+                    base = len(history_per_worker[w]) - steps
+                    for t, r in enumerate(rows):
+                        self.metrics_writer.log(
+                            step=base + t + 1, worker=w,
+                            samples=self.batch_size, **r,
+                        )
         if self.checkpointer is not None:
-            ck_step, state = self.checkpointer.restore(like={
-                "params": center,
-                "opt_state": {
+            self.checkpointer.maybe_save(
+                epoch + 1, jax.tree.map(np.asarray, center),
+                {
                     "worker": jax.tree.map(np.asarray, worker),
                     "opt": jax.tree.map(np.asarray, opt_state),
                 },
-                "extra": {"epoch": 0},
-            })
-            if state is not None:
-                center = state["params"]
-                start_epoch = int(state["extra"].get("epoch", ck_step))
-                if state["opt_state"]:
-                    worker = state["opt_state"]["worker"]
-                    opt_state = state["opt_state"]["opt"]
-
-        batch_sharding = NamedSharding(mesh, P(None, "dp"))
-
-        def put_feed(arr):
-            return jax.device_put(arr, batch_sharding)
-
-        # windows: full W-batch groups + one tail group (its own compile)
-        groups = [(s, min(s + W, n_b)) for s in range(0, n_b, W)]
-        staged = xb.nbytes + yb.nbytes <= self.stage_limit_bytes
-        if staged:
-            xb_d, yb_d = put_feed(xb), put_feed(yb)
-
-        history_per_worker: List[History] = [[] for _ in range(n_dev)]
-        for epoch in range(start_epoch, self.num_epoch):
-            epoch_ms = []
-            for s, e in groups:
-                if staged:
-                    xw, yw = xb_d[s:e], yb_d[s:e]
-                else:
-                    xw, yw = put_feed(xb[s:e]), put_feed(yb[s:e])
-                worker, opt_state, center, ms = window_step(
-                    worker, opt_state, center, xw, yw
-                )
-                epoch_ms.append(ms)
-            for ms in epoch_ms:
-                ms = {k: np.asarray(v) for k, v in ms.items()}
-                steps = next(iter(ms.values())).shape[1]
-                for w in range(n_dev):
-                    rows = [
-                        {k: float(v[w, t]) for k, v in ms.items()}
-                        for t in range(steps)
-                    ]
-                    history_per_worker[w].extend(rows)
-                    if self.metrics_writer is not None:
-                        base = len(history_per_worker[w]) - steps
-                        for t, r in enumerate(rows):
-                            self.metrics_writer.log(
-                                step=base + t + 1, worker=w,
-                                samples=self.batch_size, **r,
-                            )
-            if self.checkpointer is not None:
-                self.checkpointer.maybe_save(
-                    epoch + 1, jax.tree.map(np.asarray, center),
-                    {
-                        "worker": jax.tree.map(np.asarray, worker),
-                        "opt": jax.tree.map(np.asarray, opt_state),
-                    },
-                    extra={"epoch": epoch + 1},
-                    force=(epoch + 1 == self.num_epoch),
-                )
-        self.params = jax.tree.map(np.asarray, center)
-        self.executor_histories = history_per_worker
-        self.history = history_per_worker[0]
-        return Model(self.model, self.params)
+                extra={"epoch": epoch + 1,
+                       "engine_id": _SPMD_ENGINE_IDS[engine],
+                       "workers": n_dev},
+                force=(epoch + 1 == self.num_epoch),
+            )
+    self.params = jax.tree.map(np.asarray, center)
+    self.executor_histories = history_per_worker
+    self.history = history_per_worker[0]
+    return Model(self.model, self.params)
 
 
 class DataParallelTrainer(Trainer):
@@ -1793,10 +1888,17 @@ class LMTrainer(Trainer):
                 f"batch_size={B} not divisible by microbatches={M}"
             )
         micro_B = B // M
-        if micro_B % dp != 0:
+        # batch_size counts THIS process's rows; the assembled global
+        # microbatch is micro_B * process_count, and that is what the dp
+        # axis slices (ADVICE r3 #3 — validating the per-process count
+        # against the global dp extent rejected valid multi-process
+        # configs like pc=2, dp=4, micro_B=2)
+        global_micro_B = micro_B * jax.process_count()
+        if global_micro_B % dp != 0:
             raise ValueError(
-                f"microbatch size {micro_B} (= batch_size/{M}) not "
-                f"divisible by dp={dp}"
+                f"global microbatch size {global_micro_B} (= batch_size/"
+                f"{M} x {jax.process_count()} processes) not divisible "
+                f"by dp={dp}"
             )
 
         optimizer = get_optimizer(self.worker_optimizer, self.learning_rate)
