@@ -58,6 +58,75 @@ class AccuracyEvaluator(Evaluator):
         return self._score(pred, label) / len(label)
 
 
+class PerplexityEvaluator(Evaluator):
+    """Next-token perplexity of a language model over a token dataset
+    (VERDICT r3 next #8; no reference counterpart — the reference has no
+    sequence models).
+
+    ``evaluate(dataset)`` takes a :class:`PartitionedDataset` or a
+    :class:`~distkeras_tpu.data.shard_io.ShardedDataset` with a
+    ``tokens_col`` column of ``[N, T]`` int token ids and returns
+    ``exp(mean next-token cross-entropy)`` — the exact corpus-level mean
+    (token-count weighted), streamed shard by shard / partition by
+    partition with one jitted batch evaluation, so corpora far larger
+    than device memory evaluate at one batch of residency.
+    """
+
+    def __init__(self, model, batch_size: int = 8,
+                 tokens_col: str = "tokens"):
+        self.model = model  # a models.wrapper.Model (module + params)
+        self.batch_size = batch_size
+        self.tokens_col = tokens_col
+
+    def _batch_sums(self, toks):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        if not hasattr(self, "_jit"):
+            module = self.model.module
+
+            @jax.jit
+            def f(params, toks):
+                logits = module.apply(params, toks)
+                ce = optax.softmax_cross_entropy_with_integer_labels(
+                    logits[:, :-1], toks[:, 1:]
+                )
+                return ce.sum(), ce.size
+
+            self._jit = f
+        s, n = self._jit(self.model.params, jnp.asarray(toks))
+        return float(s), int(n)
+
+    def _chunks(self, dataset):
+        from distkeras_tpu.data.shard_io import ShardedDataset
+
+        if isinstance(dataset, ShardedDataset):
+            for i in range(dataset.num_shards):
+                yield dataset.read_shard(i)[self.tokens_col]
+        else:
+            for i in range(dataset.num_partitions):
+                yield dataset.partition(i)[self.tokens_col]
+
+    def evaluate(self, dataset) -> float:
+        total = count = 0
+        B = self.batch_size
+        for toks in self._chunks(dataset):
+            toks = np.asarray(toks)
+            if toks.ndim != 2:
+                raise ValueError(
+                    f"'{self.tokens_col}' must be [N, T] token ids; got "
+                    f"shape {toks.shape}"
+                )
+            for s in range(0, len(toks), B):
+                bs, bn = self._batch_sums(toks[s:s + B])
+                total += bs
+                count += bn
+        if count == 0:
+            raise ValueError("empty dataset")
+        return float(np.exp(total / count))
+
+
 class LossEvaluator(Evaluator):
     """Mean loss between a prediction column and a label column (no
     reference counterpart; rounds out the evaluation vocabulary)."""

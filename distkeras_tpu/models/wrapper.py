@@ -54,3 +54,23 @@ class Model:
 
     def replace_params(self, params: Any) -> "Model":
         return Model(self.module, params)
+
+    def generate(self, prompt, max_new_tokens: int,
+                 temperature: float = 0.0, seed: int = 0,
+                 eos_id=None) -> np.ndarray:
+        """Autoregressive sampling (language models only): delegates to
+        :func:`distkeras_tpu.models.transformer.generate` with this
+        model's params — so ``trainer.train(...).generate(prompt, n)``
+        emits tokens straight from a training run, and a deserialized
+        Model generates identically (round-trip tested)."""
+        from distkeras_tpu.models import transformer
+
+        if not hasattr(self.module, "max_len"):
+            raise TypeError(
+                f"{type(self.module).__name__} is not a language model; "
+                "generate() needs a TransformerLM-family module"
+            )
+        return np.asarray(transformer.generate(
+            self.module, self.params, prompt, max_new_tokens,
+            temperature=temperature, seed=seed, eos_id=eos_id,
+        ))

@@ -69,6 +69,11 @@ def main():
     ap.add_argument("--batch-size", type=int, default=32)
     ap.add_argument("--epochs", type=int, default=2)
     ap.add_argument("--metrics", default=None, help="JSONL metrics path")
+    ap.add_argument("--sample", type=int, default=0, metavar="N",
+                    help="after training, greedy-decode N tokens from a "
+                         "corpus prompt via the KV cache and print them")
+    ap.add_argument("--temperature", type=float, default=0.0,
+                    help="sampling temperature for --sample (0 = greedy)")
     args = ap.parse_args()
 
     import jax
@@ -118,7 +123,26 @@ def main():
         # tells the user the flag needs a pp axis
         microbatches=args.microbatches,
     )
-    trainer.train(ds)
+    trained = trainer.train(ds)
+
+    if args.sample:
+        # inference story (VERDICT r3 #8): prompt with the first period of
+        # a held-in sequence; a trained model continues the pattern
+        # the KV cache is max_len (= seq_len) long: prompt + new must fit
+        Tp = min(16, args.seq_len - args.sample)
+        if Tp < 1:
+            print(f"--sample {args.sample} leaves no room for a prompt "
+                  f"inside max_len={args.seq_len}; skipping sampling")
+        else:
+            prompt = tokens[:2, :Tp]
+            out = trained.generate(
+                prompt, max_new_tokens=args.sample,
+                temperature=args.temperature,
+            )
+            for r, row in enumerate(out):
+                cont = " ".join(str(int(t)) for t in row[Tp:])
+                head = " ".join(str(int(t)) for t in prompt[r][:8])
+                print(f"sample[{r}]: prompt={head} ... -> {cont}")
 
     first, last = trainer.history[0]["loss"], trainer.history[-1]["loss"]
     toks = len(trainer.history) * args.batch_size * args.seq_len

@@ -1,0 +1,190 @@
+"""LM inference: KV-cache incremental decode must match the full forward
+pass exactly, generate() must round-trip through serde, and the perplexity
+evaluator must equal the directly-computed corpus CE (VERDICT r3 next #8)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distkeras_tpu.models import get_model
+from distkeras_tpu.models.transformer import generate
+
+KW = dict(vocab_size=64, d_model=64, num_heads=2, num_layers=2,
+          max_len=64, dtype=jnp.float32, attention="dense")
+
+
+def _model_and_params(seed=0, **over):
+    kw = dict(KW)
+    kw.update(over)
+    model = get_model("transformer_lm", **kw)
+    toks = jnp.zeros((2, 8), jnp.int32)
+    return model, model.init(jax.random.PRNGKey(seed), toks)
+
+
+def test_greedy_decode_matches_full_recompute():
+    """The cached decode path IS the model: greedy generation through the
+    KV cache must equal the naive loop that re-runs the full forward on
+    the growing sequence every step."""
+    model, params = _model_and_params()
+    rng = np.random.default_rng(0)
+    prompt = jnp.asarray(rng.integers(0, 64, size=(2, 7)), jnp.int32)
+
+    out = generate(model, params, prompt, max_new_tokens=9)
+
+    seq = np.asarray(prompt)
+    for _ in range(9):
+        logits = model.apply(params, jnp.asarray(seq))
+        nxt = np.asarray(jnp.argmax(logits[:, -1], axis=-1))
+        seq = np.concatenate([seq, nxt[:, None].astype(np.int32)], axis=1)
+    np.testing.assert_array_equal(np.asarray(out), seq)
+
+
+def test_prefill_logits_match_full_forward():
+    """Teacher-forcing check: decode-mode apply over the whole prompt
+    produces the same logits as the training-mode forward."""
+    model, params = _model_and_params(seed=1)
+    rng = np.random.default_rng(1)
+    toks = jnp.asarray(rng.integers(0, 64, size=(2, 12)), jnp.int32)
+    full = model.apply(params, toks)
+
+    dm = model.clone(decode=True, parent=None)
+    cache = jax.tree.map(
+        lambda s: jnp.zeros(s.shape, s.dtype),
+        jax.eval_shape(dm.init, jax.random.PRNGKey(0),
+                       jnp.zeros((2, 1), jnp.int32))["cache"],
+    )
+    dec, _ = dm.apply(
+        {"params": params["params"], "cache": cache}, toks,
+        mutable=["cache"],
+    )
+    np.testing.assert_allclose(
+        np.asarray(dec), np.asarray(full), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_token_by_token_decode_matches_prefill():
+    """Feeding the prompt one token at a time through the cache gives the
+    same final logits as one prefill call (the cursor/mask bookkeeping)."""
+    model, params = _model_and_params(seed=2)
+    rng = np.random.default_rng(2)
+    toks = jnp.asarray(rng.integers(0, 64, size=(1, 6)), jnp.int32)
+
+    dm = model.clone(decode=True, parent=None)
+    cache = jax.tree.map(
+        lambda s: jnp.zeros(s.shape, s.dtype),
+        jax.eval_shape(dm.init, jax.random.PRNGKey(0),
+                       jnp.zeros((1, 1), jnp.int32))["cache"],
+    )
+    v = {"params": params["params"], "cache": cache}
+    for t in range(6):
+        logits, vs = dm.apply(v, toks[:, t:t + 1], mutable=["cache"])
+        v = {"params": params["params"], "cache": vs["cache"]}
+    full = model.apply(params, toks)
+    np.testing.assert_allclose(
+        np.asarray(logits[:, -1]), np.asarray(full[:, -1]),
+        rtol=2e-5, atol=2e-5,
+    )
+
+
+def test_generate_train_save_load_sample_roundtrip():
+    """The VERDICT deliverable: train -> save -> load -> sample, and the
+    sampled continuation follows the learned pattern."""
+    import optax
+
+    from distkeras_tpu.models.wrapper import Model
+
+    model, params = _model_and_params(seed=3)
+    # learnable task: next token = (token + 1) % 32
+    rng = np.random.default_rng(3)
+    start = rng.integers(0, 32, size=(16,))
+    toks = jnp.asarray(
+        (start[:, None] + np.arange(48)[None, :]) % 32, jnp.int32
+    )
+    opt = optax.adamw(3e-3)
+    state = opt.init(params)
+
+    @jax.jit
+    def step(p, s, t):
+        def loss_fn(p):
+            logits = model.apply(p, t)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits[:, :-1], t[:, 1:]).mean()
+        l, g = jax.value_and_grad(loss_fn)(p)
+        u, s = opt.update(g, s, p)
+        return optax.apply_updates(p, u), s, l
+
+    for _ in range(150):
+        params, state, loss = step(params, state, toks)
+    assert float(loss) < 0.1, float(loss)
+
+    blob = Model(model, params).serialize()
+    loaded = Model.deserialize(blob)
+    prompt = jnp.asarray([[5, 6, 7, 8]], jnp.int32)
+    out = loaded.generate(prompt, max_new_tokens=8)
+    np.testing.assert_array_equal(
+        out[0, 4:], (np.arange(8) + 9) % 32
+    )
+
+
+def test_generate_temperature_and_eos():
+    model, params = _model_and_params(seed=4)
+    prompt = jnp.asarray([[1, 2], [3, 4]], jnp.int32)
+    a = generate(model, params, prompt, 6, temperature=0.8, seed=7)
+    b = generate(model, params, prompt, 6, temperature=0.8, seed=7)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    c = generate(model, params, prompt, 6, temperature=0.8, seed=8)
+    assert not np.array_equal(np.asarray(a), np.asarray(c))
+    # eos: once emitted, the row keeps emitting eos
+    e = np.asarray(generate(model, params, prompt, 6, eos_id=0))
+    for row in e:
+        seen = False
+        for t in row[2:]:
+            if seen:
+                assert t == 0
+            seen = seen or (t == 0)
+
+
+def test_generate_validates():
+    model, params = _model_and_params()
+    with pytest.raises(ValueError, match="max_len"):
+        generate(model, params, jnp.zeros((1, 60), jnp.int32), 10)
+    with pytest.raises(ValueError, match="prompt"):
+        generate(model, params, jnp.zeros((3,), jnp.int32), 2)
+    from distkeras_tpu.models.wrapper import Model
+    cnn = Model(get_model("cifar_cnn"), None)
+    with pytest.raises(TypeError, match="language model"):
+        cnn.generate(jnp.zeros((1, 2), jnp.int32), 2)
+
+
+def test_perplexity_evaluator_matches_direct():
+    import optax
+
+    from distkeras_tpu import PartitionedDataset
+    from distkeras_tpu.data.shard_io import ShardedDataset, write_shards
+    from distkeras_tpu.evaluators import PerplexityEvaluator
+    from distkeras_tpu.models.wrapper import Model
+
+    model, params = _model_and_params(seed=5)
+    rng = np.random.default_rng(5)
+    toks = rng.integers(0, 64, size=(20, 16)).astype(np.int32)
+    ds = PartitionedDataset.from_arrays({"tokens": toks}, 3)
+
+    ev = PerplexityEvaluator(Model(model, params), batch_size=8)
+    got = ev.evaluate(ds)
+
+    ce = optax.softmax_cross_entropy_with_integer_labels(
+        model.apply(params, jnp.asarray(toks))[:, :-1],
+        jnp.asarray(toks)[:, 1:],
+    )
+    expect = float(np.exp(np.asarray(ce).mean()))
+    np.testing.assert_allclose(got, expect, rtol=1e-5)
+
+    # streamed shards == in-memory
+    import tempfile
+    with tempfile.TemporaryDirectory() as d:
+        write_shards(ds, d)
+        got_stream = PerplexityEvaluator(
+            Model(model, params), batch_size=8
+        ).evaluate(ShardedDataset(d))
+    np.testing.assert_allclose(got_stream, expect, rtol=1e-5)
