@@ -93,9 +93,12 @@ def _peak_flops():
     return None
 
 
-def lm_bench():
+def lm_bench(D=2048, H=8, L=8, V=8192, B=8, T=2048, remat="none",
+             calls=4):
     """Flagship TransformerLM training throughput + MFU on one chip.
 
+    Parameterized so the long-context sweep (``benchmarks/lm_scan.py``)
+    reports the same exact-MFU accounting as the headline config.
     Returns extra JSON fields, or ``{"lm_error": ...}`` when the step
     doesn't fit/compile (e.g. on a small-RAM CPU host). A NaN loss or a
     code bug still raises."""
@@ -103,13 +106,12 @@ def lm_bench():
 
     from distkeras_tpu.models import get_model
 
-    D, H, L, V, B, T = 2048, 8, 8, 8192, 8, 2048
     W = 5  # optimizer steps per dispatch (scan window)
     # 'standard' auto-selects the Pallas causal-skip kernel on TPU
     # (~1.9x over the blocked kernel at this T), blocked elsewhere
     model = get_model("transformer_lm", vocab_size=V, d_model=D,
                       num_heads=H, num_layers=L, max_len=T,
-                      attention="standard")
+                      attention="standard", remat=remat)
     toks = jnp.asarray(
         np.random.default_rng(0).integers(0, V, size=(W, B, T)), jnp.int32
     )
@@ -146,7 +148,6 @@ def lm_bench():
         flops = _flops_per_call(single, params, opt_state, toks[0])
         params, opt_state, losses = window(params, opt_state, toks)
         float(np.asarray(losses)[-1])  # force completion past warm-up
-        calls = 4
         t0 = time.perf_counter()
         for _ in range(calls):
             params, opt_state, losses = window(params, opt_state, toks)
@@ -165,12 +166,16 @@ def lm_bench():
                   T, D // H,
                   itemsize=jnp.dtype(model.dtype).itemsize)
               else "blocked")
+    tag = "" if remat == "none" else f"-remat:{remat}"
     out = {
         "lm_tokens_per_sec_per_chip": round(steps * B * T / dt, 1),
-        "lm_config": f"d{D}/h{H}/L{L}/v{V}/T{T}/b{B}-bf16-{kernel}-adamw",
+        "lm_config": f"d{D}/h{H}/L{L}/v{V}/T{T}/b{B}-bf16-{kernel}"
+                     f"-adamw{tag}",
     }
     peak = _peak_flops()
-    if flops is not None and peak is not None:
+    # MFU only without remat: recompute makes executed != model FLOPs and
+    # the two conventions shouldn't be mixed in one headline number
+    if flops is not None and peak is not None and remat == "none":
         if kernel == "pallas-causal":
             # exact MFU: add the custom-call FLOPs XLA can't see
             flops += _pallas_attn_flops(

@@ -1,0 +1,54 @@
+"""LM decode (serving) throughput: tokens/sec of KV-cache generation on
+the flagship TransformerLM — the inference-side counterpart of bench.py's
+training numbers. One jitted prefill + scan decode per call; the second
+call reuses the compiled closure (the _generate_fn cache), so the steady
+state is what's measured.
+
+Prints one JSON line: {"decode_tokens_per_sec": ..., "config": ...}.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def main(D=2048, H=8, L=8, V=8192, B=8, prompt_len=128, new_tokens=256):
+    from distkeras_tpu.models import get_model
+    from distkeras_tpu.models.transformer import generate
+
+    T = prompt_len + new_tokens
+    model = get_model("transformer_lm", vocab_size=V, d_model=D,
+                      num_heads=H, num_layers=L, max_len=T)
+    prompt = jnp.asarray(
+        np.random.default_rng(0).integers(0, V, size=(B, prompt_len)),
+        jnp.int32,
+    )
+    params = model.init(jax.random.PRNGKey(0), prompt)
+
+    out = generate(model, params, prompt, new_tokens)  # compile
+    int(np.asarray(out)[0, -1])  # force completion (tunnel transports
+    # can return early from block_until_ready; fetching data cannot lie)
+    calls = 3
+    t0 = time.perf_counter()
+    for i in range(calls):
+        out = generate(model, params, prompt, new_tokens, seed=i)
+        last = int(np.asarray(out)[0, -1])
+    dt = time.perf_counter() - t0
+    assert 0 <= last < V
+    print(json.dumps({
+        "decode_tokens_per_sec": round(calls * B * new_tokens / dt, 1),
+        "config": f"d{D}/h{H}/L{L}/v{V}/b{B}-prompt{prompt_len}"
+                  f"-new{new_tokens}-greedy-bf16",
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -7,6 +7,8 @@ filter/count actions.
 
 from __future__ import annotations
 
+import functools
+
 import numpy as np
 
 from distkeras_tpu.data.dataset import PartitionedDataset
@@ -17,6 +19,27 @@ class Evaluator:
 
     def evaluate(self, dataset: PartitionedDataset) -> float:
         raise NotImplementedError
+
+
+@functools.lru_cache(maxsize=128)
+def _ppl_batch_fn(module):
+    """Jitted (CE sum, count) over the valid rows of one [B, T] batch,
+    cached per module value (flax modules hash by config — the
+    wrapper._jitted_apply pattern)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    @jax.jit
+    def f(params, toks, n_valid):
+        logits = module.apply(params, toks)
+        ce = optax.softmax_cross_entropy_with_integer_labels(
+            logits[:, :-1], toks[:, 1:]
+        )
+        mask = (jnp.arange(toks.shape[0]) < n_valid).astype(ce.dtype)
+        return (ce * mask[:, None]).sum(), n_valid * ce.shape[1]
+
+    return f
 
 
 class AccuracyEvaluator(Evaluator):
@@ -78,24 +101,17 @@ class PerplexityEvaluator(Evaluator):
         self.batch_size = batch_size
         self.tokens_col = tokens_col
 
-    def _batch_sums(self, toks):
-        import jax
+    def _batch_sums(self, toks, n_valid: int):
+        """(CE sum, token count) over the first ``n_valid`` rows of a
+        full-[B, T] batch (ragged tails arrive padded, so one compiled
+        shape serves the whole corpus). The jitted fn is cached per
+        MODULE (not per evaluator), so reassigning ``self.model`` or
+        evaluating many models shares/refreshes compiles correctly."""
         import jax.numpy as jnp
-        import optax
 
-        if not hasattr(self, "_jit"):
-            module = self.model.module
-
-            @jax.jit
-            def f(params, toks):
-                logits = module.apply(params, toks)
-                ce = optax.softmax_cross_entropy_with_integer_labels(
-                    logits[:, :-1], toks[:, 1:]
-                )
-                return ce.sum(), ce.size
-
-            self._jit = f
-        s, n = self._jit(self.model.params, jnp.asarray(toks))
+        s, n = _ppl_batch_fn(self.model.module)(
+            self.model.params, jnp.asarray(toks), n_valid
+        )
         return float(s), int(n)
 
     def _chunks(self, dataset):
@@ -119,7 +135,14 @@ class PerplexityEvaluator(Evaluator):
                     f"shape {toks.shape}"
                 )
             for s in range(0, len(toks), B):
-                bs, bn = self._batch_sums(toks[s:s + B])
+                b = toks[s:s + B]
+                n_valid = len(b)
+                if n_valid < B:  # pad the ragged tail: one compiled shape
+                    b = np.concatenate(
+                        [b, np.zeros((B - n_valid,) + b.shape[1:],
+                                     b.dtype)]
+                    )
+                bs, bn = self._batch_sums(b, n_valid)
                 total += bs
                 count += bn
         if count == 0:

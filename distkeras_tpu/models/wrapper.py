@@ -34,10 +34,23 @@ class Model:
 
     def predict(self, x) -> np.ndarray:
         """Batched forward pass → host numpy (the reference's
-        ``model.predict``, but one XLA call per batch instead of per row)."""
+        ``model.predict``, but one XLA call per batch instead of per row).
+        Multi-input graph models take a tuple/list of arrays; multi-output
+        models return a tuple of arrays."""
         import jax.numpy as jnp
 
-        return np.asarray(self.apply_jit(self.params, jnp.asarray(x)))
+        # only a declared-multi-input module treats a list as separate
+        # inputs — a plain list of rows on a single-input model keeps its
+        # long-standing np.asarray([rows]) batching
+        if (getattr(self.module, "num_inputs", 1) > 1
+                and isinstance(x, (tuple, list))):
+            x = tuple(jnp.asarray(a) for a in x)
+        else:
+            x = jnp.asarray(x)
+        out = self.apply_jit(self.params, x)
+        if isinstance(out, tuple):
+            return tuple(np.asarray(o) for o in out)
+        return np.asarray(out)
 
     def serialize(self) -> dict:
         from distkeras_tpu.models.registry import model_spec

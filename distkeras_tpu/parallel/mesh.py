@@ -43,6 +43,52 @@ def make_mesh(axes: Dict[str, int], devices: Optional[Sequence] = None) -> Mesh:
     return Mesh(grid, tuple(axes.keys()))
 
 
+def replica_groups(mesh: Mesh, batch_axis: str = "dp"):
+    """Group the mesh's processes by the ``batch_axis`` coordinates their
+    devices cover — the data-feed unit for multi-process streaming
+    (VERDICT r3 next #7).
+
+    Processes whose devices sit at the SAME batch coordinates (their
+    model/sequence shards span processes, e.g. sp or tp wider than one
+    host's device count) are batch REPLICAS: they must feed identical
+    rows, or ``make_array_from_process_local_data``-style assembly trains
+    on inconsistent data with no error. Processes at disjoint batch
+    coordinates feed disjoint rows (the classic dp split).
+
+    Returns ``(group_index_of_this_process, n_groups)`` where groups are
+    numbered by ascending batch coordinate, so group ``g`` owns the
+    ``g``-th contiguous block of global batch rows.
+
+    Raises NotImplementedError for irregular layouts (footprints neither
+    identical nor disjoint, non-contiguous, or unequal) — those would
+    need a per-device feed map rather than a group stride.
+    """
+    ax = mesh.axis_names.index(batch_axis)
+    dev = np.asarray(mesh.devices)
+    foot: Dict[int, set] = {}
+    for idx in np.ndindex(dev.shape):
+        foot.setdefault(dev[idx].process_index, set()).add(idx[ax])
+    fps = {pi: frozenset(s) for pi, s in foot.items()}
+    uniq = sorted(set(fps.values()), key=min)
+    seen: set = set()
+    size = len(uniq[0])
+    for f in uniq:
+        if seen & f or len(f) != size or max(f) - min(f) != size - 1:
+            raise NotImplementedError(
+                f"process device footprints along '{batch_axis}' are "
+                "neither identical nor equal disjoint contiguous blocks "
+                f"({sorted(map(sorted, fps.values()))}); this mesh/process "
+                "layout has no group-stride data feed"
+            )
+        seen |= f
+    me = jax.process_index()
+    if me not in fps:  # a process with no devices in this mesh
+        raise ValueError(
+            f"process {me} owns no devices of this mesh; cannot feed it"
+        )
+    return uniq.index(fps[me]), len(uniq)
+
+
 def default_mesh(num_workers: Optional[int] = None) -> Mesh:
     """1-D data-parallel mesh over the first ``num_workers`` devices
     (default: all local devices) — the shape every reference trainer uses."""

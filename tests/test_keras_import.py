@@ -307,14 +307,22 @@ def test_functional_linear_chain_imports():
     )
 
 
-def test_functional_branch_raises():
+def test_functional_branch_imports_via_graph_path():
+    """r3 refused branch/merge graphs; r4's KerasImportedGraph imports
+    them (full coverage in test_keras_import_graph.py)."""
+    from distkeras_tpu.utils.keras_import import KerasImportedGraph
+
     inp = keras.Input((8,))
     a = keras.layers.Dense(8, name="a")(inp)
     b = keras.layers.Dense(8, name="b")(inp)
     out = keras.layers.Add(name="add")([a, b])
     km = keras.Model(inp, out)
-    with pytest.raises(ValueError, match="linear chain"):
-        from_keras(km)
+    model = from_keras(km)
+    assert isinstance(model.module, KerasImportedGraph)
+    x = np.random.default_rng(0).normal(size=(4, 8)).astype(np.float32)
+    np.testing.assert_allclose(
+        model.predict(x), km.predict(x, verbose=0), rtol=2e-3, atol=2e-3
+    )
 
 
 def test_train_mode_batchnorm_matches_keras_training_step():

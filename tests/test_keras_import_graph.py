@@ -1,0 +1,181 @@
+"""General functional-graph Keras import (VERDICT r3 missing #1): skip
+connections, merge layers, multi-input and multi-output models must import
+and match live Keras predictions; only layer reuse refuses, by name."""
+
+import numpy as np
+import pytest
+
+from distkeras_tpu.utils.keras_import import (
+    from_keras,
+    from_keras_config,
+    keras_available,
+)
+
+pytestmark = pytest.mark.skipif(
+    not keras_available(), reason="keras not importable"
+)
+
+TOL = dict(rtol=2e-3, atol=2e-3)  # TPU/f32 matmul path divergence
+
+
+def _keras():
+    import keras
+
+    return keras
+
+
+def test_skip_connection_add_matches_keras():
+    keras = _keras()
+    inp = keras.Input((12,))
+    h = keras.layers.Dense(16, activation="relu")(inp)
+    h2 = keras.layers.Dense(16, activation="relu")(h)
+    merged = keras.layers.Add()([h, h2])  # residual branch
+    out = keras.layers.Dense(3, activation="softmax")(merged)
+    km = keras.Model(inp, out)
+
+    x = np.random.default_rng(0).normal(size=(8, 12)).astype(np.float32)
+    ours = from_keras(km)
+    np.testing.assert_allclose(
+        ours.predict(x), km.predict(x, verbose=0), **TOL
+    )
+
+
+@pytest.mark.parametrize("merge_cls,n", [
+    ("Concatenate", 2), ("Multiply", 2), ("Average", 3),
+    ("Maximum", 2), ("Subtract", 2),
+])
+def test_merge_layers_match_keras(merge_cls, n):
+    keras = _keras()
+    inp = keras.Input((10,))
+    branches = [
+        keras.layers.Dense(8, activation="tanh")(inp) for _ in range(n)
+    ]
+    merged = getattr(keras.layers, merge_cls)()(branches)
+    out = keras.layers.Dense(4)(merged)
+    km = keras.Model(inp, out)
+
+    x = np.random.default_rng(1).normal(size=(5, 10)).astype(np.float32)
+    ours = from_keras(km)
+    np.testing.assert_allclose(
+        ours.predict(x), km.predict(x, verbose=0), **TOL,
+        err_msg=merge_cls,
+    )
+
+
+def test_multi_input_model_matches_keras():
+    keras = _keras()
+    a = keras.Input((6,))
+    b = keras.Input((4,))
+    ha = keras.layers.Dense(8, activation="relu")(a)
+    hb = keras.layers.Dense(8, activation="relu")(b)
+    merged = keras.layers.Concatenate()([ha, hb])
+    out = keras.layers.Dense(2)(merged)
+    km = keras.Model([a, b], out)
+
+    rng = np.random.default_rng(2)
+    xa = rng.normal(size=(7, 6)).astype(np.float32)
+    xb = rng.normal(size=(7, 4)).astype(np.float32)
+    ours = from_keras(km)
+    np.testing.assert_allclose(
+        ours.predict([xa, xb]), km.predict([xa, xb], verbose=0), **TOL
+    )
+
+
+def test_multi_output_model_matches_keras():
+    keras = _keras()
+    inp = keras.Input((9,))
+    trunk = keras.layers.Dense(12, activation="relu")(inp)
+    head_a = keras.layers.Dense(3, activation="softmax")(trunk)
+    head_b = keras.layers.Dense(1)(trunk)
+    km = keras.Model(inp, [head_a, head_b])
+
+    x = np.random.default_rng(3).normal(size=(6, 9)).astype(np.float32)
+    ours = from_keras(km)
+    got = ours.predict(x)
+    want = km.predict(x, verbose=0)
+    assert isinstance(got, tuple) and len(got) == 2
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(g, w, **TOL)
+
+
+def test_conv_branch_model_matches_keras():
+    """Branchy CNN (inception-ish cell): conv branches + pooling branch,
+    concatenated along channels."""
+    keras = _keras()
+    inp = keras.Input((8, 8, 3))
+    b1 = keras.layers.Conv2D(4, 1, activation="relu", padding="same")(inp)
+    b2 = keras.layers.Conv2D(4, 3, activation="relu", padding="same")(inp)
+    b3 = keras.layers.AveragePooling2D(2, strides=1, padding="same")(inp)
+    merged = keras.layers.Concatenate()([b1, b2, b3])
+    flat = keras.layers.Flatten()(merged)
+    out = keras.layers.Dense(5)(flat)
+    km = keras.Model(inp, out)
+
+    x = np.random.default_rng(4).normal(size=(3, 8, 8, 3)).astype(np.float32)
+    ours = from_keras(km)
+    np.testing.assert_allclose(
+        ours.predict(x), km.predict(x, verbose=0), **TOL
+    )
+
+
+def test_graph_config_path_needs_no_keras_object():
+    """The reference's interchange blob (to_json config + weights) imports
+    through the pure-data path for graphs too."""
+    import json
+
+    keras = _keras()
+    inp = keras.Input((5,))
+    h = keras.layers.Dense(6, activation="relu")(inp)
+    merged = keras.layers.Add()([h, keras.layers.Dense(6)(inp)])
+    km = keras.Model(inp, keras.layers.Dense(2)(merged))
+
+    config = json.loads(km.to_json())["config"]
+    ours = from_keras_config(config, km.get_weights())
+    x = np.random.default_rng(5).normal(size=(4, 5)).astype(np.float32)
+    np.testing.assert_allclose(
+        ours.predict(x), km.predict(x, verbose=0), **TOL
+    )
+
+
+def test_graph_serde_round_trip():
+    from distkeras_tpu.models.wrapper import Model
+
+    keras = _keras()
+    inp = keras.Input((5,))
+    h = keras.layers.Dense(6, activation="relu")(inp)
+    merged = keras.layers.Add()([h, keras.layers.Dense(6)(inp)])
+    km = keras.Model(inp, keras.layers.Dense(2)(merged))
+
+    ours = from_keras(km)
+    loaded = Model.deserialize(ours.serialize())
+    x = np.random.default_rng(6).normal(size=(4, 5)).astype(np.float32)
+    np.testing.assert_allclose(
+        loaded.predict(x), ours.predict(x), rtol=1e-6, atol=1e-7
+    )
+
+
+def test_layer_reuse_refuses_by_name():
+    keras = _keras()
+    a = keras.Input((4,))
+    b = keras.Input((4,))
+    shared = keras.layers.Dense(4, name="shared_dense")
+    merged = keras.layers.Add()([shared(a), shared(b)])
+    km = keras.Model([a, b], merged)
+    with pytest.raises(ValueError, match="shared_dense"):
+        from_keras(km)
+
+
+def test_strip_final_softmax_on_graph():
+    keras = _keras()
+    inp = keras.Input((6,))
+    h = keras.layers.Dense(8, activation="relu")(inp)
+    merged = keras.layers.Add()([h, keras.layers.Dense(8)(inp)])
+    out = keras.layers.Dense(3, activation="softmax")(merged)
+    km = keras.Model(inp, out)
+
+    x = np.random.default_rng(7).normal(size=(4, 6)).astype(np.float32)
+    logits = from_keras(km, strip_final_softmax=True).predict(x)
+    probs = from_keras(km).predict(x)
+    # softmax(logits) == probs
+    e = np.exp(logits - logits.max(-1, keepdims=True))
+    np.testing.assert_allclose(e / e.sum(-1, keepdims=True), probs, **TOL)
