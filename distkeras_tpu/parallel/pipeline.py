@@ -106,7 +106,11 @@ def make_pp_lm_train_step(model, optimizer, mesh: Mesh,
     ``step(pp_params, opt_state, tokens) -> (pp_params, opt_state, loss)``
     with loss the global mean next-token cross-entropy.
     """
-    from distkeras_tpu.models.transformer import Block, sinusoidal_positions
+    from distkeras_tpu.models.transformer import (
+        Block,
+        VocabHead,
+        sinusoidal_positions,
+    )
     from distkeras_tpu.parallel.spmd import opt_state_specs
 
     ax = dict(zip(mesh.axis_names, mesh.devices.shape))
@@ -139,7 +143,8 @@ def make_pp_lm_train_step(model, optimizer, mesh: Mesh,
                       tp_size=tp, tp_axis=tp_axis or "tp")
     embed_mod = nn.Embed(model.vocab_size, model.d_model, dtype=model.dtype)
     ln_mod = nn.LayerNorm(dtype=model.dtype)
-    head_mod = nn.Dense(model.vocab_size, dtype=jnp.float32)
+    # same math as the module's head (bf16 MXU operands, f32 accum)
+    head_mod = VocabHead(model.vocab_size, model.dtype)
     pos_table = sinusoidal_positions(model.max_len, model.d_model)
 
     def device_step(params, opt_state, tokens):

