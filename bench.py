@@ -151,11 +151,16 @@ def lm_bench(D=2048, H=8, L=8, V=8192, B=8, T=2048, remat="none",
         flops = _flops_per_call(single, params, opt_state, toks[0])
         params, opt_state, losses = window(params, opt_state, toks)
         float(np.asarray(losses)[-1])  # force completion past warm-up
-        t0 = time.perf_counter()
-        for _ in range(calls):
-            params, opt_state, losses = window(params, opt_state, toks)
-        final = float(np.asarray(losses)[-1])
-        dt = time.perf_counter() - t0
+        # best-of-3 timing blocks: the tunneled transport adds multi-ms
+        # jitter per dispatch; the MINIMUM block is the chip's actual
+        # cost (each block still fetches a scalar, so it can't lie)
+        dt = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(calls):
+                params, opt_state, losses = window(params, opt_state, toks)
+            final = float(np.asarray(losses)[-1])
+            dt = min(dt, time.perf_counter() - t0)
     except Exception as e:
         return {"lm_error": f"{type(e).__name__}: {str(e)[:160]}"}
     assert np.isfinite(final), f"flagship LM loss diverged: {final}"
@@ -224,11 +229,15 @@ def main():
     params, opt_state, ms = step(params, opt_state, x, y)
     float(np.asarray(ms["loss"])[-1])
 
-    t0 = time.perf_counter()
-    for _ in range(calls):
-        params, opt_state, ms = step(params, opt_state, x, y)
-    final_loss = float(np.asarray(ms["loss"])[-1])
-    dt = time.perf_counter() - t0
+    # best-of-3 blocks: minimum wall time is the chip's cost under the
+    # tunnel's transport jitter (see lm_bench)
+    dt = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(calls):
+            params, opt_state, ms = step(params, opt_state, x, y)
+        final_loss = float(np.asarray(ms["loss"])[-1])
+        dt = min(dt, time.perf_counter() - t0)
     assert np.isfinite(final_loss)
 
     # the step is a single-device jit program: the measurement IS per-chip

@@ -411,7 +411,9 @@ class TransformerLM(nn.Module):
 
 def generate(model, params, prompt, max_new_tokens: int,
              temperature: float = 0.0, seed: int = 0,
-             eos_id: Optional[int] = None) -> jnp.ndarray:
+             eos_id: Optional[int] = None,
+             top_k: Optional[int] = None,
+             top_p: Optional[float] = None) -> jnp.ndarray:
     """Autoregressive sampling from a trained :class:`TransformerLM`
     (VERDICT r3 next #8 — a framework that headlines LM training must be
     able to emit tokens).
@@ -433,6 +435,10 @@ def generate(model, params, prompt, max_new_tokens: int,
         ``softmax(logits / temperature)``.
       seed: PRNG seed for sampled decoding.
       eos_id: optional stop token — finished rows keep emitting it.
+      top_k: restrict sampling to the k highest-logit tokens.
+      top_p: nucleus sampling — restrict to the smallest set of tokens
+        whose cumulative probability exceeds ``top_p``. Composes with
+        ``top_k`` (k-filter first, then the nucleus).
 
     Returns:
       ``[B, T_prompt + max_new_tokens]`` int32.
@@ -440,6 +446,10 @@ def generate(model, params, prompt, max_new_tokens: int,
     prompt = jnp.asarray(prompt, jnp.int32)
     if prompt.ndim != 2 or prompt.shape[1] < 1:
         raise ValueError(f"prompt must be [B, T>=1]; got {prompt.shape}")
+    if top_k is not None and top_k < 1:
+        raise ValueError(f"top_k must be >= 1; got {top_k}")
+    if top_p is not None and not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1]; got {top_p}")
     B, Tp = prompt.shape
     if Tp + max_new_tokens > model.max_len:
         raise ValueError(
@@ -447,14 +457,16 @@ def generate(model, params, prompt, max_new_tokens: int,
             f"max_len={model.max_len} (the KV-cache length)"
         )
     dm = model.clone(decode=True, parent=None)
-    run = _generate_fn(dm, B, max_new_tokens, temperature, eos_id)
+    run = _generate_fn(dm, B, max_new_tokens, temperature, eos_id,
+                       top_k, top_p)
     new = run({"params": params["params"]}, prompt,
               jax.random.PRNGKey(seed))
     return jnp.concatenate([prompt, new], axis=1)
 
 
 @functools.lru_cache(maxsize=32)
-def _generate_fn(dm, B, max_new_tokens, temperature, eos_id):
+def _generate_fn(dm, B, max_new_tokens, temperature, eos_id,
+                 top_k=None, top_p=None):
     """Compiled prefill + decode-scan closure, cached per (decode module,
     batch, token count, sampling config) — flax modules hash by config,
     so repeated generate() calls (sampling loops, serving) hit the jit
@@ -465,9 +477,30 @@ def _generate_fn(dm, B, max_new_tokens, temperature, eos_id):
     def sample(logits, rng):
         if temperature == 0.0:
             return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        return jax.random.categorical(
-            rng, logits / temperature
-        ).astype(jnp.int32)
+        logits = logits / temperature
+        if top_k is not None or top_p is not None:
+            # ONE descending sort serves both filters (this runs per
+            # decoded token): the k-filter folds into the sorted view as
+            # an -inf tail, which is exactly the sorted masked
+            # distribution the nucleus then operates on
+            sorted_desc = jnp.sort(logits, axis=-1)[..., ::-1]
+            if top_k is not None:
+                kth = sorted_desc[..., top_k - 1, None]
+                logits = jnp.where(logits < kth, -jnp.inf, logits)
+                sorted_desc = jnp.where(
+                    jnp.arange(sorted_desc.shape[-1]) >= top_k,
+                    -jnp.inf, sorted_desc,
+                )
+            if top_p is not None:
+                # nucleus: keep the smallest prefix of the sorted
+                # distribution whose mass exceeds top_p (the top token
+                # always survives: its cum - prob is 0 <= top_p)
+                probs = jax.nn.softmax(sorted_desc, axis=-1)
+                beyond = jnp.cumsum(probs, axis=-1) - probs > top_p
+                kept = jnp.where(beyond, jnp.inf, sorted_desc)
+                thresh = jnp.min(kept, axis=-1, keepdims=True)
+                logits = jnp.where(logits < thresh, -jnp.inf, logits)
+        return jax.random.categorical(rng, logits).astype(jnp.int32)
 
     @jax.jit
     def run(params_only, prompt, rng):

@@ -70,7 +70,7 @@ class Model:
 
     def generate(self, prompt, max_new_tokens: int,
                  temperature: float = 0.0, seed: int = 0,
-                 eos_id=None) -> np.ndarray:
+                 eos_id=None, top_k=None, top_p=None) -> np.ndarray:
         """Autoregressive sampling (language models only): delegates to
         :func:`distkeras_tpu.models.transformer.generate` with this
         model's params — so ``trainer.train(...).generate(prompt, n)``
@@ -86,4 +86,5 @@ class Model:
         return np.asarray(transformer.generate(
             self.module, self.params, prompt, max_new_tokens,
             temperature=temperature, seed=seed, eos_id=eos_id,
+            top_k=top_k, top_p=top_p,
         ))

@@ -145,6 +145,43 @@ def test_generate_temperature_and_eos():
             seen = seen or (t == 0)
 
 
+def test_top_k_and_top_p_restrict_support():
+    """Every sampled token must fall inside the allowed candidate set of
+    the teacher-forced next-token distribution at its position."""
+    model, params = _model_and_params(seed=6)
+    prompt = jnp.asarray([[7, 3, 9]], jnp.int32)
+
+    def replay_check(out, allowed_fn):
+        seq = np.asarray(out)
+        for t in range(prompt.shape[1], seq.shape[1]):
+            logits = np.asarray(
+                model.apply(params, jnp.asarray(seq[:, :t]))
+            )[0, -1]
+            assert seq[0, t] in allowed_fn(logits), t
+
+    out = generate(model, params, prompt, 6, temperature=1.0, top_k=2,
+                   seed=3)
+    replay_check(out, lambda lg: set(np.argsort(lg)[-2:]))
+
+    # a tiny nucleus keeps only the argmax -> equals greedy
+    out_p = generate(model, params, prompt, 6, temperature=1.0,
+                     top_p=1e-6, seed=3)
+    greedy = generate(model, params, prompt, 6)
+    np.testing.assert_array_equal(np.asarray(out_p), np.asarray(greedy))
+
+    # top_p=1.0 keeps everything -> identical to plain sampling
+    a = generate(model, params, prompt, 6, temperature=0.8, top_p=1.0,
+                 seed=5)
+    b = generate(model, params, prompt, 6, temperature=0.8, seed=5)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    import pytest as _pt
+    with _pt.raises(ValueError, match="top_k"):
+        generate(model, params, prompt, 2, top_k=0)
+    with _pt.raises(ValueError, match="top_p"):
+        generate(model, params, prompt, 2, top_p=1.5)
+
+
 def test_generate_validates():
     model, params = _model_and_params()
     with pytest.raises(ValueError, match="max_len"):
