@@ -446,8 +446,11 @@ def generate(model, params, prompt, max_new_tokens: int,
     prompt = jnp.asarray(prompt, jnp.int32)
     if prompt.ndim != 2 or prompt.shape[1] < 1:
         raise ValueError(f"prompt must be [B, T>=1]; got {prompt.shape}")
-    if top_k is not None and top_k < 1:
-        raise ValueError(f"top_k must be >= 1; got {top_k}")
+    if top_k is not None:
+        if top_k < 1:
+            raise ValueError(f"top_k must be >= 1; got {top_k}")
+        # k >= vocab keeps everything; clamp instead of crashing at trace
+        top_k = min(top_k, model.vocab_size)
     if top_p is not None and not 0.0 < top_p <= 1.0:
         raise ValueError(f"top_p must be in (0, 1]; got {top_p}")
     B, Tp = prompt.shape
@@ -534,6 +537,153 @@ def _generate_fn(dm, B, max_new_tokens, temperature, eos_id,
             length=max_new_tokens,
         )
         return toks.T  # [B, max_new_tokens]
+
+    return run
+
+
+def beam_search(model, params, prompt, max_new_tokens: int,
+                beam_size: int = 4, length_penalty: float = 0.0,
+                eos_id: Optional[int] = None) -> jnp.ndarray:
+    """Beam-search decoding on the KV-cache decode path.
+
+    Standard fixed-width beam search: prefill once on the B prompt rows,
+    tile each layer's cache ``beam_size``× along the batch axis, then one
+    ``lax.scan`` where every step scores all ``beam_size × vocab``
+    continuations per row, keeps the top ``beam_size`` by cumulative
+    log-probability, and gathers the KV caches of the surviving beams'
+    parents. The whole search is ONE jitted dispatch, like
+    :func:`generate`.
+
+    Args:
+      length_penalty: GNMT-style α — candidates are ranked by
+        ``logprob / ((5 + len) / 6) ** α``; 0 ranks by raw logprob.
+      eos_id: finished beams freeze (their only continuation is ``eos``
+        at zero cost), so shorter completed hypotheses compete with
+        longer live ones.
+
+    Returns:
+      ``[B, T_prompt + max_new_tokens]`` int32 — each row's best beam.
+    """
+    prompt = jnp.asarray(prompt, jnp.int32)
+    if prompt.ndim != 2 or prompt.shape[1] < 1:
+        raise ValueError(f"prompt must be [B, T>=1]; got {prompt.shape}")
+    if beam_size < 1:
+        raise ValueError(f"beam_size must be >= 1; got {beam_size}")
+    B, Tp = prompt.shape
+    if Tp + max_new_tokens > model.max_len:
+        raise ValueError(
+            f"prompt ({Tp}) + max_new_tokens ({max_new_tokens}) exceeds "
+            f"max_len={model.max_len} (the KV-cache length)"
+        )
+    dm = model.clone(decode=True, parent=None)
+    run = _beam_fn(dm, B, max_new_tokens, beam_size, length_penalty,
+                   eos_id)
+    best = run({"params": params["params"]}, prompt)
+    return jnp.concatenate([prompt, best], axis=1)
+
+
+@functools.lru_cache(maxsize=32)
+def _beam_fn(dm, B, max_new_tokens, K, length_penalty, eos_id):
+    def penalize(scores, lengths):
+        # GNMT: logprob / ((5 + true_hypothesis_length) / 6)^alpha —
+        # lengths are PER HYPOTHESIS (frozen when a beam finishes), so
+        # early-eos beams aren't over-favored by a shared step count
+        if length_penalty == 0.0:
+            return scores
+        return scores / (
+            ((5.0 + lengths.astype(jnp.float32)) / 6.0) ** length_penalty
+        )
+
+    @jax.jit
+    def run(params_only, prompt):
+        V = dm.vocab_size
+        cache = jax.tree.map(
+            lambda s: jnp.zeros(s.shape, s.dtype),
+            jax.eval_shape(
+                dm.init, jax.random.PRNGKey(0), jnp.zeros((B, 1), jnp.int32)
+            )["cache"],
+        )
+        logits, vs = dm.apply(
+            {**params_only, "cache": cache}, prompt, mutable=["cache"]
+        )
+        # tile caches K× along batch: row b's beams live at rows b*K..;
+        # every per-batch cache leaf (cached K/V) repeats, scalars
+        # (cursors) are shared across rows already
+        cache = jax.tree.map(
+            lambda c: (jnp.repeat(c, K, axis=0)
+                       if c.ndim > 0 and c.shape[0] == B else c),
+            vs["cache"],
+        )
+        logp0 = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32))
+        # beam 0 is live, the rest start at -inf so step 1 seeds K
+        # DISTINCT tokens from the top of the prompt distribution
+        init_scores = jnp.full((B, K), -jnp.inf).at[:, 0].set(0.0)
+        done0 = jnp.zeros((B, K), bool)
+        lens0 = jnp.zeros((B, K), jnp.int32)
+        toks_buf = jnp.zeros((B, K, max_new_tokens), jnp.int32)
+
+        def expand(scores, logp, done, lens, step):
+            # scores [B,K] + per-beam next-token logprobs [B,K,V] ->
+            # top-K flat candidates per row, ranked by length-penalized
+            # score (candidate length = frozen for finished parents,
+            # step+1 for live ones)
+            if eos_id is not None:
+                # finished beams: only eos continues, at zero cost
+                only_eos = jnp.full((V,), -jnp.inf).at[eos_id].set(0.0)
+                logp = jnp.where(done[..., None], only_eos, logp)
+            cand_len = jnp.where(done, lens, step + 1)  # [B, K]
+            total = scores[..., None] + logp  # [B, K, V]
+            flat = total.reshape(B, K * V)
+            flat_len = jnp.broadcast_to(
+                cand_len[..., None], (B, K, V)
+            ).reshape(B, K * V)
+            _, idx = jax.lax.top_k(penalize(flat, flat_len), K)  # [B, K]
+            parent = idx // V
+            token = (idx % V).astype(jnp.int32)
+            new_scores = jnp.take_along_axis(flat, idx, axis=1)
+            new_lens = jnp.take_along_axis(flat_len, idx, axis=1)
+            return parent, token, new_scores, new_lens
+
+        def step(carry, i):
+            cache, scores, toks_buf, done, lens, last_logp = carry
+            parent, token, scores, lens = expand(
+                scores, last_logp, done, lens, i
+            )
+            # gather surviving parents' state: global cache row b*K+parent
+            rows = (jnp.arange(B)[:, None] * K + parent).reshape(-1)
+            cache = jax.tree.map(
+                lambda c: (jnp.take(c, rows, axis=0)
+                           if c.ndim > 0 and c.shape[0] == B * K else c),
+                cache,
+            )
+            toks_buf = jnp.take_along_axis(
+                toks_buf, parent[..., None], axis=1
+            )
+            toks_buf = jax.lax.dynamic_update_index_in_dim(
+                toks_buf, token, i, axis=2
+            )
+            if eos_id is not None:
+                done = jnp.take_along_axis(done, parent, axis=1)
+                done = done | (token == eos_id)
+            logits, vs = dm.apply(
+                {**params_only, "cache": cache},
+                token.reshape(B * K)[:, None], mutable=["cache"],
+            )
+            logp = jax.nn.log_softmax(
+                logits[:, -1].astype(jnp.float32)
+            ).reshape(B, K, V)
+            return (vs["cache"], scores, toks_buf, done, lens, logp), None
+
+        logp_init = jnp.broadcast_to(logp0[:, None], (B, K, V))
+        (cache, scores, toks_buf, done, lens, _), _ = jax.lax.scan(
+            step,
+            (cache, init_scores, toks_buf, done0, lens0, logp_init),
+            jnp.arange(max_new_tokens),
+        )
+        best = jnp.argmax(penalize(scores, lens), axis=1)
+        return jnp.take_along_axis(
+            toks_buf, best[:, None, None], axis=1
+        )[:, 0]
 
     return run
 

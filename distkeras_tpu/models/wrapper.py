@@ -88,3 +88,20 @@ class Model:
             temperature=temperature, seed=seed, eos_id=eos_id,
             top_k=top_k, top_p=top_p,
         ))
+
+    def beam_search(self, prompt, max_new_tokens: int, beam_size: int = 4,
+                    length_penalty: float = 0.0, eos_id=None) -> np.ndarray:
+        """Beam-search decoding (language models only) — see
+        :func:`distkeras_tpu.models.transformer.beam_search`."""
+        from distkeras_tpu.models import transformer
+
+        if not hasattr(self.module, "max_len"):
+            raise TypeError(
+                f"{type(self.module).__name__} is not a language model; "
+                "beam_search() needs a TransformerLM-family module"
+            )
+        return np.asarray(transformer.beam_search(
+            self.module, self.params, prompt, max_new_tokens,
+            beam_size=beam_size, length_penalty=length_penalty,
+            eos_id=eos_id,
+        ))

@@ -213,6 +213,91 @@ def test_moe_lm_generates():
     np.testing.assert_array_equal(np.asarray(out), seq)
 
 
+def test_beam_size_one_equals_greedy():
+    from distkeras_tpu.models.transformer import beam_search
+
+    model, params = _model_and_params(seed=8)
+    prompt = jnp.asarray([[2, 4, 6], [1, 3, 5]], jnp.int32)
+    beam = beam_search(model, params, prompt, 7, beam_size=1)
+    greedy = generate(model, params, prompt, 7)
+    np.testing.assert_array_equal(np.asarray(beam), np.asarray(greedy))
+
+
+def test_full_width_beam_finds_global_optimum():
+    """With beam_size >= every candidate at every depth, beam search IS
+    exhaustive search: its result must be the argmax-logprob sequence
+    over all vocab^h continuations (brute-forced by teacher forcing)."""
+    from distkeras_tpu.models.transformer import beam_search
+
+    V, h = 6, 3
+    model, params = _model_and_params(seed=9, vocab_size=V, d_model=32,
+                                      num_heads=1, max_len=16)
+    prompt = jnp.asarray([[1, 2]], jnp.int32)
+    out = beam_search(model, params, prompt, h, beam_size=V ** h)
+
+    import itertools
+
+    best_score, best_seq = -np.inf, None
+    for cont in itertools.product(range(V), repeat=h):
+        seq = np.concatenate([np.asarray(prompt)[0], np.asarray(cont)])
+        logits = np.asarray(
+            model.apply(params, jnp.asarray(seq[None, :-1]))
+        )[0]
+        lp = jax.nn.log_softmax(jnp.asarray(logits, jnp.float32), axis=-1)
+        score = float(sum(
+            lp[prompt.shape[1] - 1 + t, cont[t]] for t in range(h)
+        ))
+        if score > best_score:
+            best_score, best_seq = score, seq
+    np.testing.assert_array_equal(np.asarray(out)[0], best_seq)
+
+
+def test_beam_eos_freezes_finished_hypotheses():
+    from distkeras_tpu.models.transformer import beam_search
+
+    model, params = _model_and_params(seed=10)
+    prompt = jnp.asarray([[3, 1]], jnp.int32)
+    out = np.asarray(
+        beam_search(model, params, prompt, 8, beam_size=3, eos_id=0)
+    )
+    seen = False
+    for t in out[0, 2:]:
+        if seen:
+            assert t == 0
+        seen = seen or (t == 0)
+
+
+def test_beam_length_penalty_and_topk_clamp():
+    from distkeras_tpu.models.transformer import beam_search
+
+    model, params = _model_and_params(seed=11)
+    prompt = jnp.asarray([[3, 1]], jnp.int32)
+    # per-hypothesis GNMT penalty: runs, keeps eos-frozen property
+    out = np.asarray(beam_search(model, params, prompt, 8, beam_size=3,
+                                 eos_id=0, length_penalty=0.6))
+    seen = False
+    for t in out[0, 2:]:
+        if seen:
+            assert t == 0
+        seen = seen or (t == 0)
+    # top_k beyond the vocab clamps to keep-everything == plain sampling
+    a = generate(model, params, prompt, 5, temperature=0.7, seed=2,
+                 top_k=10_000)
+    b = generate(model, params, prompt, 5, temperature=0.7, seed=2)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_beam_search_validates():
+    from distkeras_tpu.models.transformer import beam_search
+
+    model, params = _model_and_params()
+    with pytest.raises(ValueError, match="beam_size"):
+        beam_search(model, params, jnp.zeros((1, 2), jnp.int32), 2,
+                    beam_size=0)
+    with pytest.raises(ValueError, match="max_len"):
+        beam_search(model, params, jnp.zeros((1, 60), jnp.int32), 10)
+
+
 def test_perplexity_evaluator_matches_direct():
     import optax
 
