@@ -24,8 +24,11 @@ params)`` pair:
   (running-stats BN + stochastic Dropout) for continued training instead
   of the inference-exact frozen fold;
 - :func:`to_keras_config` / :func:`to_keras` — export back to the Keras
-  format (config + ``get_weights()`` list / a live ``Sequential``), so a
+  format (config + ``get_weights()`` list / a live model), so a
   migrating team can hand models back to surviving Keras infrastructure.
+  Sequentials export Keras-free; imported GRAPHS export too
+  (:func:`to_keras_graph` rebuilds the functional model by direct
+  construction, so that path needs keras importable).
 
 Supported layers (the reference's example vocabulary): Dense, Conv2D,
 Flatten, Reshape, MaxPooling2D, AveragePooling2D, Dropout (identity —
@@ -682,9 +685,19 @@ def keras_config_to_graph_spec(
                 "config is not in creation order"
             )
         if cls == "InputLayer":
+            in_cfg = lc.get("config", {})
+            shape = in_cfg.get("batch_shape") or in_cfg.get(
+                "batch_input_shape"
+            )
             nodes.append(("input", (
-                ("ordinal", input_names.index(name)),
+                # batch_shape/dtype are kept for the export path
+                # (to_keras_graph rebuilds keras.Input from them — an
+                # int32 embedding input must not export as float32)
+                ("batch_shape",
+                 tuple(shape) if shape is not None else None),
                 ("cast", True),  # fixed up below for embedding consumers
+                ("dtype", in_cfg.get("dtype")),
+                ("ordinal", input_names.index(name)),
             ), ()))
             continue
         if cls in _MERGE_CLASS:
@@ -994,6 +1007,14 @@ def to_keras_config(model) -> Tuple[Dict[str, Any], List[np.ndarray]]:
     ``train_mode`` imports export the true gamma/beta/mean/var.
     """
     module = model.module
+    if isinstance(module, KerasImportedGraph):
+        # functional graphs export through a live rebuild (requires
+        # keras): direct construction beats config-format archaeology,
+        # and to_json round-trips it into the interchange shape
+        import json as _json
+
+        km = to_keras_graph(model)
+        return _json.loads(km.to_json())["config"], km.get_weights()
     if not isinstance(module, KerasImported):
         raise ValueError(
             "to_keras_config exports models built by the Keras importer "
@@ -1005,51 +1026,129 @@ def to_keras_config(model) -> Tuple[Dict[str, Any], List[np.ndarray]]:
     layers: List[Dict[str, Any]] = []
     weights: List[np.ndarray] = []
     for i, (kind, cfg_items) in enumerate(module.layers):
-        cfg = {k: _unfreeze(v) for k, v in cfg_items}
-        name = f"layer_{i}"
-        entry = params.get(name, {})
-        if kind in ("dense", "conv2d", "conv1d"):
-            cfg.setdefault("activation", "linear")
-            cfg["activation"] = cfg["activation"] or "linear"
-            weights.append(np.asarray(entry["kernel"]))
-            if "bias" in entry:
-                weights.append(np.asarray(entry["bias"]))
-        elif kind == "embedding":
-            weights.append(np.asarray(entry["embeddings"]))
-        elif kind in ("lstm", "gru"):
-            weights.append(np.asarray(entry["kernel"]))
-            weights.append(np.asarray(entry["recurrent"]))
-            if "bias" in entry:
-                weights.append(np.asarray(entry["bias"]))
-        elif kind == "batchnorm":
-            eps = float(cfg.get("epsilon", 1e-3))
-            if name in stats:  # train_mode import: true stats survive
-                if "scale" in entry:
-                    weights.append(np.asarray(entry["scale"]))
-                if "bias" in entry:
-                    weights.append(np.asarray(entry["bias"]))
-                weights.append(np.asarray(stats[name]["mean"]))
-                weights.append(np.asarray(stats[name]["var"]))
-            else:
-                # folded affine: emit gamma=scale, beta=bias, mean=0,
-                # var=1-eps so gamma*(x-0)/sqrt(var+eps)+beta == sx+b
-                cfg["scale"] = True
-                cfg["center"] = True
-                s = np.asarray(entry["scale"])
-                weights.append(s)
-                weights.append(np.asarray(entry["bias"]))
-                weights.append(np.zeros_like(s))
-                weights.append(np.full_like(s, 1.0 - eps))
-        layers.append({"class_name": _KIND_TO_KERAS[kind], "config": cfg})
+        cls, cfg, wlist = _export_layer(
+            kind, cfg_items, params.get(f"layer_{i}", {}),
+            stats.get(f"layer_{i}"),
+        )
+        weights.extend(wlist)
+        layers.append({"class_name": cls, "config": cfg})
     return {"name": "keras_exported", "layers": layers}, weights
 
 
-def to_keras(model, example_input):
-    """Framework ``Model`` → live ``keras.Sequential`` with the weights
-    installed (requires keras importable). ``example_input`` builds the
-    layer weights before ``set_weights`` (Keras creates them lazily)."""
+def _export_layer(kind, cfg_items, entry, stats_entry):
+    """One imported layer → (Keras class name, config, weight list) in
+    Keras' own layouts/order — shared by the Sequential and graph
+    exporters."""
+    cfg = {k: _unfreeze(v) for k, v in cfg_items}
+    weights: List[np.ndarray] = []
+    if kind in ("dense", "conv2d", "conv1d"):
+        cfg.setdefault("activation", "linear")
+        cfg["activation"] = cfg["activation"] or "linear"
+        weights.append(np.asarray(entry["kernel"]))
+        if "bias" in entry:
+            weights.append(np.asarray(entry["bias"]))
+    elif kind == "embedding":
+        weights.append(np.asarray(entry["embeddings"]))
+    elif kind in ("lstm", "gru"):
+        weights.append(np.asarray(entry["kernel"]))
+        weights.append(np.asarray(entry["recurrent"]))
+        if "bias" in entry:
+            weights.append(np.asarray(entry["bias"]))
+    elif kind == "batchnorm":
+        eps = float(cfg.get("epsilon", 1e-3))
+        if stats_entry is not None:  # train_mode import: true stats
+            if "scale" in entry:
+                weights.append(np.asarray(entry["scale"]))
+            if "bias" in entry:
+                weights.append(np.asarray(entry["bias"]))
+            weights.append(np.asarray(stats_entry["mean"]))
+            weights.append(np.asarray(stats_entry["var"]))
+        else:
+            # folded affine: emit gamma=scale, beta=bias, mean=0,
+            # var=1-eps so gamma*(x-0)/sqrt(var+eps)+beta == sx+b
+            cfg["scale"] = True
+            cfg["center"] = True
+            s = np.asarray(entry["scale"])
+            weights.append(s)
+            weights.append(np.asarray(entry["bias"]))
+            weights.append(np.zeros_like(s))
+            weights.append(np.full_like(s, 1.0 - eps))
+    return _KIND_TO_KERAS[kind], cfg, weights
+
+
+def to_keras_graph(model):
+    """Framework ``Model`` over a :class:`KerasImportedGraph` → live
+    functional ``keras.Model`` with weights installed (requires keras
+    importable — the graph is rebuilt by direct functional construction,
+    sidestepping config-format archaeology). Inputs/outputs keep the
+    imported order; weight order is node order, which is what
+    ``get_weights`` emitted at import time."""
     import keras
 
+    module = model.module
+    params = model.params.get("params", {})
+    stats = model.params.get("batch_stats", {})
+    tensors: Dict[int, Any] = {}
+    inputs: List[Tuple[int, Any]] = []
+    all_weights: List[np.ndarray] = []
+    inv_merge = {v: k for k, v in _MERGE_CLASS.items()}
+    for i, (kind, cfg_items, parents) in enumerate(module.nodes):
+        cfg = dict(cfg_items)
+        name = f"exp_{i}"
+        if kind == "input":
+            shape = cfg.get("batch_shape")
+            if shape is None:
+                raise ValueError(
+                    "graph export needs input shapes recorded at import "
+                    "time; re-import this model to refresh the spec"
+                )
+            t = keras.Input(batch_shape=list(shape), name=name,
+                            dtype=cfg.get("dtype") or None)
+            inputs.append((cfg["ordinal"], t))
+            tensors[i] = t
+        elif kind in _MERGE_KINDS:
+            kwargs = {"name": name}
+            if kind == "concatenate":
+                kwargs["axis"] = int(cfg.get("axis", -1))
+            layer = getattr(keras.layers, inv_merge[kind])(**kwargs)
+            tensors[i] = layer([tensors[p] for p in parents])
+        else:
+            cls, lcfg, wlist = _export_layer(
+                kind, cfg_items, params.get(f"layer_{i}", {}),
+                stats.get(f"layer_{i}"),
+            )
+            lcfg = dict(lcfg)
+            lcfg["name"] = name
+            layer = getattr(keras.layers, cls).from_config(lcfg)
+            tensors[i] = layer(tensors[parents[0]])
+            all_weights.extend(wlist)
+    inputs = [t for _, t in sorted(inputs, key=lambda p: p[0])]
+    outputs = [tensors[o] for o in module.outputs]
+    km = keras.Model(
+        inputs[0] if len(inputs) == 1 else inputs,
+        outputs[0] if len(outputs) == 1 else outputs,
+    )
+    km.set_weights(all_weights)
+    return km
+
+
+def to_keras(model, example_input=None):
+    """Framework ``Model`` → live Keras model with the weights installed
+    (requires keras importable): ``keras.Sequential`` for
+    :class:`KerasImported`, a functional ``keras.Model`` for
+    :class:`KerasImportedGraph` (via :func:`to_keras_graph`).
+    ``example_input`` builds the Sequential's layer weights before
+    ``set_weights`` (Keras creates them lazily); graphs build from their
+    recorded input shapes and ignore it."""
+    import keras
+
+    if isinstance(model.module, KerasImportedGraph):
+        return to_keras_graph(model)
+    if example_input is None:
+        raise ValueError(
+            "to_keras needs example_input for Sequential models (Keras "
+            "builds weights lazily)"
+        )
     config, weights = to_keras_config(model)
     km = keras.Sequential.from_config(config)
     km(np.asarray(example_input))  # build

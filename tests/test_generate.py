@@ -252,19 +252,29 @@ def test_full_width_beam_finds_global_optimum():
     np.testing.assert_array_equal(np.asarray(out)[0], best_seq)
 
 
+def _assert_eos_freezes(row, Tp, eos):
+    """eos appears AND every subsequent position repeats it."""
+    seen = False
+    for t in row[Tp:]:
+        if seen:
+            assert t == eos, row
+        seen = seen or (t == eos)
+    assert seen, (row, eos)
+
+
 def test_beam_eos_freezes_finished_hypotheses():
     from distkeras_tpu.models.transformer import beam_search
 
     model, params = _model_and_params(seed=10)
     prompt = jnp.asarray([[3, 1]], jnp.int32)
+    # pick an eos the search actually emits (the first decoded token of
+    # the eos-free run), so the freeze path demonstrably fires
+    free = np.asarray(beam_search(model, params, prompt, 8, beam_size=3))
+    eos = int(free[0, 2])
     out = np.asarray(
-        beam_search(model, params, prompt, 8, beam_size=3, eos_id=0)
+        beam_search(model, params, prompt, 8, beam_size=3, eos_id=eos)
     )
-    seen = False
-    for t in out[0, 2:]:
-        if seen:
-            assert t == 0
-        seen = seen or (t == 0)
+    _assert_eos_freezes(out[0], 2, eos)
 
 
 def test_beam_length_penalty_and_topk_clamp():
@@ -272,14 +282,14 @@ def test_beam_length_penalty_and_topk_clamp():
 
     model, params = _model_and_params(seed=11)
     prompt = jnp.asarray([[3, 1]], jnp.int32)
-    # per-hypothesis GNMT penalty: runs, keeps eos-frozen property
+    # per-hypothesis GNMT penalty with an eos that demonstrably fires:
+    # finished (frozen-length) and live beams then really compete
+    free = np.asarray(beam_search(model, params, prompt, 8, beam_size=3,
+                                  length_penalty=0.6))
+    eos = int(free[0, 2])
     out = np.asarray(beam_search(model, params, prompt, 8, beam_size=3,
-                                 eos_id=0, length_penalty=0.6))
-    seen = False
-    for t in out[0, 2:]:
-        if seen:
-            assert t == 0
-        seen = seen or (t == 0)
+                                 eos_id=eos, length_penalty=0.6))
+    _assert_eos_freezes(out[0], 2, eos)
     # top_k beyond the vocab clamps to keep-everything == plain sampling
     a = generate(model, params, prompt, 5, temperature=0.7, seed=2,
                  top_k=10_000)

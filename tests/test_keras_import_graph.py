@@ -154,6 +154,81 @@ def test_graph_serde_round_trip():
     )
 
 
+def test_graph_export_round_trips_to_live_keras():
+    """Import a DAG (with a folded BN in one branch), export back to a
+    live functional keras.Model: predictions must match the original."""
+    from distkeras_tpu.utils.keras_import import to_keras
+
+    keras = _keras()
+    inp = keras.Input((10,))
+    a = keras.layers.Dense(8, activation="relu")(inp)
+    b = keras.layers.Dense(8)(inp)
+    b = keras.layers.BatchNormalization()(b)
+    merged = keras.layers.Add()([a, b])
+    out = keras.layers.Dense(3, activation="softmax")(merged)
+    km = keras.Model(inp, out)
+    km.predict(np.zeros((1, 10), np.float32), verbose=0)  # build stats
+
+    ours = from_keras(km)
+    km2 = to_keras(ours)
+    x = np.random.default_rng(8).normal(size=(6, 10)).astype(np.float32)
+    np.testing.assert_allclose(
+        km2.predict(x, verbose=0), km.predict(x, verbose=0),
+        rtol=1e-5, atol=1e-5,
+    )
+
+
+def test_graph_export_multi_input_config_shape():
+    """to_keras_config on a graph model emits the reference interchange
+    shape (config dict + weights) that from_keras_config re-imports."""
+    from distkeras_tpu.utils.keras_import import to_keras_config
+
+    keras = _keras()
+    a = keras.Input((6,))
+    b = keras.Input((4,))
+    merged = keras.layers.Concatenate()([
+        keras.layers.Dense(5, activation="tanh")(a),
+        keras.layers.Dense(5, activation="tanh")(b),
+    ])
+    km = keras.Model([a, b], keras.layers.Dense(2)(merged))
+
+    ours = from_keras(km)
+    config, weights = to_keras_config(ours)
+    again = from_keras_config(config, weights)
+    rng = np.random.default_rng(9)
+    xa = rng.normal(size=(3, 6)).astype(np.float32)
+    xb = rng.normal(size=(3, 4)).astype(np.float32)
+    np.testing.assert_allclose(
+        again.predict([xa, xb]), ours.predict([xa, xb]),
+        rtol=1e-5, atol=1e-5,
+    )
+
+
+def test_graph_export_preserves_input_dtype():
+    """An int32 embedding input must export as int32, not float32 — the
+    serving-signature contract of the original model."""
+    from distkeras_tpu.utils.keras_import import to_keras
+
+    keras = _keras()
+    inp = keras.Input((5,), dtype="int32")
+    h = keras.layers.Embedding(16, 8)(inp)
+    h = keras.layers.Flatten()(h)
+    merged = keras.layers.Add()([
+        keras.layers.Dense(6)(h), keras.layers.Dense(6)(h),
+    ])
+    km = keras.Model(inp, merged)
+
+    km2 = to_keras(from_keras(km))
+    assert "int32" in str(km2.inputs[0].dtype)
+    x = np.random.default_rng(10).integers(0, 16, size=(3, 5)).astype(
+        np.int32
+    )
+    np.testing.assert_allclose(
+        km2.predict(x, verbose=0), km.predict(x, verbose=0),
+        rtol=1e-5, atol=1e-5,
+    )
+
+
 def test_layer_reuse_refuses_by_name():
     keras = _keras()
     a = keras.Input((4,))
