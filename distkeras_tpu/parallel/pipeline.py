@@ -138,9 +138,11 @@ def make_pp_lm_train_step(model, optimizer, mesh: Mesh,
     )
     ospec = opt_state_specs(optimizer, template, pspec)
 
+    rope = getattr(model, "pos_emb", "sinusoidal") == "rope"
     block_mod = Block(model.num_heads, dtype=model.dtype,
                       attention=model.attention,
-                      tp_size=tp, tp_axis=tp_axis or "tp")
+                      tp_size=tp, tp_axis=tp_axis or "tp",
+                      rope=rope)
     embed_mod = nn.Embed(model.vocab_size, model.d_model, dtype=model.dtype)
     ln_mod = nn.LayerNorm(dtype=model.dtype)
     # same math as the module's head (bf16 MXU operands, f32 accum)
@@ -154,6 +156,8 @@ def make_pp_lm_train_step(model, optimizer, mesh: Mesh,
         def objective(p):
             def embed_one(tok):
                 x = embed_mod.apply({"params": p["rest"]["embed"]}, tok)
+                if rope:  # positions live inside attention instead
+                    return x
                 return x + jnp.asarray(pos_table)[None, :T].astype(model.dtype)
 
             def stage(x):

@@ -993,13 +993,16 @@ def _unfreeze(v):
 
 
 def to_keras_config(model) -> Tuple[Dict[str, Any], List[np.ndarray]]:
-    """Framework ``Model`` (a :class:`KerasImported`) → Keras
-    ``(Sequential config, get_weights()-ordered weight list)``.
+    """Framework ``Model`` built by this importer → Keras
+    ``(config, get_weights()-ordered weight list)``.
 
     The round trip back to surviving Keras infrastructure (VERDICT r2
-    missing #3): feed the pair to ``keras.Sequential.from_config`` +
-    ``set_weights`` (:func:`to_keras` does exactly that), or ship it in
-    the reference's own ``{'model': to_json, 'weights': ...}`` shape.
+    missing #3): feed the pair to ``from_config`` + ``set_weights``
+    (:func:`to_keras` does exactly that), or ship it in the reference's
+    own ``{'model': to_json, 'weights': ...}`` shape. Sequential
+    (:class:`KerasImported`) models export Keras-FREE; functional graphs
+    (:class:`KerasImportedGraph`) export by rebuilding the live model
+    first (:func:`to_keras_graph`), so that path needs keras importable.
 
     Inference-mode imports carry BatchNorm as the folded affine, so the
     exported BN uses gamma=scale, beta=bias, mean=0, var=1-eps — output-
