@@ -45,6 +45,11 @@ def apply_rope(x: jnp.ndarray, pos: jnp.ndarray) -> jnp.ndarray:
     ``pos [T]`` are GLOBAL positions (ring shards and decode steps pass
     their offsets)."""
     hd = x.shape[-1]
+    if hd % 2:
+        raise ValueError(
+            f"rope needs an even head dim (pairs of rotated channels); "
+            f"got head_dim={hd} — pick d_model/num_heads even"
+        )
     half = hd // 2
     freqs = 1.0 / (10000.0 ** (jnp.arange(half, dtype=jnp.float32) / half))
     ang = pos.astype(jnp.float32)[:, None] * freqs[None, :]  # [T, half]

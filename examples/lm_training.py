@@ -74,6 +74,12 @@ def main():
                          "corpus prompt via the KV cache and print them")
     ap.add_argument("--temperature", type=float, default=0.0,
                     help="sampling temperature for --sample (0 = greedy)")
+    ap.add_argument("--beam", type=int, default=0, metavar="K",
+                    help="use beam search of width K for --sample "
+                         "instead of greedy/temperature decoding")
+    ap.add_argument("--rope", action="store_true",
+                    help="rotary position embeddings instead of the "
+                         "sinusoidal table")
     args = ap.parse_args()
 
     import jax
@@ -105,6 +111,7 @@ def main():
             num_heads=args.heads, num_layers=args.layers,
             max_len=args.seq_len, moe_experts=args.experts,
             moe_top_k=args.top_k, ep_size=args.ep, ep_axis="ep",
+            pos_emb="rope" if args.rope else "sinusoidal",
         )
     else:
         model = get_model(
@@ -114,6 +121,7 @@ def main():
             max_len=args.seq_len,
             attention="ring" if args.sp > 1 else "standard",
             seq_axis="sp", tp_size=args.tp, tp_axis="tp",
+            pos_emb="rope" if args.rope else "sinusoidal",
         )
     trainer = LMTrainer(
         model, axes=axes, batch_size=args.batch_size, num_epoch=args.epochs,
@@ -135,10 +143,16 @@ def main():
                   f"inside max_len={args.seq_len}; skipping sampling")
         else:
             prompt = tokens[:2, :Tp]
-            out = trained.generate(
-                prompt, max_new_tokens=args.sample,
-                temperature=args.temperature,
-            )
+            if args.beam:
+                out = trained.beam_search(
+                    prompt, max_new_tokens=args.sample,
+                    beam_size=args.beam,
+                )
+            else:
+                out = trained.generate(
+                    prompt, max_new_tokens=args.sample,
+                    temperature=args.temperature,
+                )
             for r, row in enumerate(out):
                 cont = " ".join(str(int(t)) for t in row[Tp:])
                 head = " ".join(str(int(t)) for t in prompt[r][:8])

@@ -152,6 +152,21 @@ def test_lm_trainer_moe_requires_ep_axis():
         LMTrainer(model, axes={"dp": 8}, batch_size=16).train(ds)
 
 
+def test_rope_model_through_trainer_and_decode():
+    """pos_emb='rope' flows end to end: LMTrainer trains it (ring sp
+    mesh), and the returned Model generates through the KV cache."""
+    ds = token_dataset()
+    model = get_model("transformer_lm", attention="ring", seq_axis="sp",
+                      pos_emb="rope", **LM_KW)
+    t = LMTrainer(model, axes={"dp": 2, "sp": 2}, batch_size=8,
+                  num_epoch=2, worker_optimizer="adam",
+                  learning_rate=1e-2)
+    trained = t.train(ds)
+    assert t.history[-1]["loss"] < t.history[0]["loss"]
+    out = trained.generate(np.asarray([[1, 2, 3]], np.int32), 4)
+    assert out.shape == (1, 7)
+
+
 def test_donation_leaves_caller_params_alive():
     """The donated LM window must never delete buffers the caller still
     owns: user-supplied init params stay usable after train()
