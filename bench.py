@@ -116,7 +116,9 @@ def lm_bench(D=2048, H=8, L=8, V=8192, B=8, T=2048, remat="none",
     toks = jnp.asarray(
         np.random.default_rng(0).integers(0, V, size=(W, B, T)), jnp.int32
     )
-    optimizer = optax.adamw(3e-4)
+    # bf16 first moment halves the largest optimizer buffer's HBM traffic
+    # (+2.7% measured, identical loss); the second moment stays f32
+    optimizer = optax.adamw(3e-4, mu_dtype=jnp.bfloat16)
 
     def loss_fn(p, tok):
         logits = model.apply(p, tok)
@@ -178,7 +180,7 @@ def lm_bench(D=2048, H=8, L=8, V=8192, B=8, T=2048, remat="none",
     out = {
         "lm_tokens_per_sec_per_chip": round(steps * B * T / dt, 1),
         "lm_config": f"d{D}/h{H}/L{L}/v{V}/T{T}/b{B}-bf16-{kernel}"
-                     f"-adamw{tag}",
+                     f"-adamw-mubf16{tag}",
     }
     peak = _peak_flops()
     # MFU only without remat: recompute makes executed != model FLOPs and
