@@ -184,20 +184,41 @@ class CausalSelfAttention(nn.Module):
     # any kernel/cache — composes with every attention mode (the kernels
     # see ordinary q/k) and with decode (the cache stores rotated keys)
     rope: bool = False
+    # grouped-query attention (VERDICT r4 next #5): num_kv_heads <
+    # num_heads shares each K/V head across num_heads/num_kv_heads query
+    # heads. The decode KV cache and its per-token HBM stream shrink by
+    # that factor — the lever for the bandwidth-bound incremental-decode
+    # regime (benchmarks/decode_bench.py). None = MHA (one KV head per
+    # query head, fused qkv projection, param tree unchanged from r4
+    # checkpoints). Declared last so existing positional callers keep
+    # their meaning.
+    num_kv_heads: Optional[int] = None
 
     _DENSE_MAX_T = 512  # short sequences: one fused dense block is fastest
 
     def _cached_attend(self, q, k, v):
         """Write this call's K/V at the cache cursor, attend q over the
         whole cache with a positions-seen-so-far mask. Works for a
-        multi-token prefill and for one-token decode steps alike."""
+        multi-token prefill and for one-token decode steps alike.
+
+        The cache holds the KV heads only ([B, L, Hk, hd]) — under GQA
+        that is the whole point: the per-step HBM stream of a
+        bandwidth-bound decode drops by H/Hk. Queries attend grouped
+        (``g`` = queries per KV head) without materializing repeated
+        K/V."""
         B, T, H, hd = q.shape
+        # LOCAL KV head count from k itself: under tensor parallelism H
+        # and k.shape[2] are this shard's slices, and the global
+        # self.num_kv_heads would mis-group (or silently zero-fill the
+        # cache) — the incoming tensors are always the truth
+        Hk = k.shape[2]
+        G = H // Hk
         L = self.cache_len
         ck = self.variable(
-            "cache", "cached_key", jnp.zeros, (B, L, H, hd), self.dtype
+            "cache", "cached_key", jnp.zeros, (B, L, Hk, hd), self.dtype
         )
         cv = self.variable(
-            "cache", "cached_value", jnp.zeros, (B, L, H, hd), self.dtype
+            "cache", "cached_value", jnp.zeros, (B, L, Hk, hd), self.dtype
         )
         idx = self.variable(
             "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
@@ -215,13 +236,16 @@ class CausalSelfAttention(nn.Module):
         )
         idx.value = cur + T
         scale = 1.0 / np.sqrt(hd)
-        s = jnp.einsum("bqhd,bkhd->bhqk", q, ck.value).astype(jnp.float32)
-        s = s * scale
+        qg = q.reshape(B, T, Hk, G, hd)
+        s = jnp.einsum(
+            "bqkgd,blkd->bkgql", qg, ck.value
+        ).astype(jnp.float32) * scale
         q_pos = cur + jnp.arange(T)
         mask = jnp.arange(L)[None, :] <= q_pos[:, None]  # [T, L]
-        s = jnp.where(mask[None, None], s, -1e30)
+        s = jnp.where(mask[None, None, None], s, -1e30)
         p = jax.nn.softmax(s, axis=-1)
-        return jnp.einsum("bhqk,bkhd->bqhd", p.astype(self.dtype), cv.value)
+        out = jnp.einsum("bkgql,blkd->bqkgd", p.astype(self.dtype), cv.value)
+        return out.reshape(B, T, H, hd)
 
     @nn.compact
     def __call__(self, x):
@@ -232,12 +256,38 @@ class CausalSelfAttention(nn.Module):
             raise ValueError(
                 f"num_heads={H} not divisible by tp_size={self.tp_size}"
             )
-        qkv = TPDenseGeneral(
-            features=(3, H, hd), in_axes=1, mode="col", shard_dim=1,
-            tp_size=self.tp_size, tp_axis=self.tp_axis, dtype=self.dtype,
-            name="qkv",
-        )(x)  # [B, T, 3, H_local, hd]
-        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        Hk = self.num_kv_heads or H
+        if H % Hk != 0:
+            raise ValueError(
+                f"num_heads={H} not divisible by num_kv_heads={Hk}"
+            )
+        if Hk % self.tp_size != 0:
+            raise ValueError(
+                f"num_kv_heads={Hk} not divisible by tp_size="
+                f"{self.tp_size} (each tp shard needs whole KV heads)"
+            )
+        if Hk == H:
+            qkv = TPDenseGeneral(
+                features=(3, H, hd), in_axes=1, mode="col", shard_dim=1,
+                tp_size=self.tp_size, tp_axis=self.tp_axis,
+                dtype=self.dtype, name="qkv",
+            )(x)  # [B, T, 3, H_local, hd]
+            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        else:
+            # GQA: separate projections (a fused qkv would force equal
+            # head counts). Param names are new ('q_proj'/'kv_proj') so
+            # an MHA checkpoint can't silently restore into a GQA model.
+            q = TPDenseGeneral(
+                features=(H, hd), in_axes=1, mode="col", shard_dim=0,
+                tp_size=self.tp_size, tp_axis=self.tp_axis,
+                dtype=self.dtype, name="q_proj",
+            )(x)  # [B, T, H_local, hd]
+            kv = TPDenseGeneral(
+                features=(2, Hk, hd), in_axes=1, mode="col", shard_dim=1,
+                tp_size=self.tp_size, tp_axis=self.tp_axis,
+                dtype=self.dtype, name="kv_proj",
+            )(x)  # [B, T, 2, Hk_local, hd]
+            k, v = kv[:, :, 0], kv[:, :, 1]
         if self.rope and not self.decode:
             # global positions: ring shards offset by their shard index;
             # the decode branch applies rope at the cache cursor instead
@@ -260,6 +310,13 @@ class CausalSelfAttention(nn.Module):
                 tp_size=self.tp_size, tp_axis=self.tp_axis,
                 dtype=self.dtype, name="out",
             )(out)
+        if Hk != H:
+            # training/prefill kernels attend over full query heads:
+            # broadcast each KV head across its G query heads (XLA fuses
+            # the repeat into the consuming matmul; the HBM win of GQA is
+            # the decode cache, handled grouped in _cached_attend)
+            k = jnp.repeat(k, H // Hk, axis=2)
+            v = jnp.repeat(v, H // Hk, axis=2)
         mode = self.attention
         if mode == "standard":
             if T <= self._DENSE_MAX_T:
@@ -282,11 +339,20 @@ class CausalSelfAttention(nn.Module):
 
             out = ring_attention(q, k, v, axis_name=self.seq_axis, causal=True)
         elif mode == "pallas":
+            from distkeras_tpu.ops import pallas_attention
             from distkeras_tpu.ops.pallas_attention import (
                 pallas_causal_attention,
             )
 
-            out = pallas_causal_attention(q, k, v)
+            # run at the block choose_block picked (the preferred() gate
+            # above guarantees one exists); T=1536/3072 etc. land on a
+            # non-default block instead of losing the kernel
+            out = pallas_causal_attention(
+                q, k, v,
+                block=pallas_attention.choose_block(
+                    T, hd, itemsize=jnp.dtype(self.dtype).itemsize
+                ) or pallas_attention.DEFAULT_BLOCK,
+            )
         elif mode == "blocked":
             from distkeras_tpu.ops.flash_attention import blocked_causal_attention
 
@@ -328,6 +394,7 @@ class Block(nn.Module):
     decode: bool = False
     cache_len: int = 0
     rope: bool = False
+    num_kv_heads: Optional[int] = None  # GQA; None = MHA
 
     @nn.compact
     def __call__(self, x):
@@ -337,6 +404,7 @@ class Block(nn.Module):
             self.num_heads, self.dtype, self.attention, self.seq_axis,
             self.tp_size, self.tp_axis,
             decode=self.decode, cache_len=self.cache_len, rope=self.rope,
+            num_kv_heads=self.num_kv_heads,
         )(h)
         h = nn.LayerNorm(dtype=self.dtype)(x)
         if self.moe_experts > 0:
@@ -405,6 +473,20 @@ class TransformerLM(nn.Module):
     # itself; composes with ring/tp/pp/decode, no additive table;
     # measured ~6% flagship throughput for the per-layer q/k rotations)
     pos_emb: str = "sinusoidal"
+    # grouped-query attention (VERDICT r4 next #5): KV heads shared by
+    # num_heads/num_kv_heads query heads each — the decode KV cache and
+    # its bandwidth-bound per-token stream shrink by that factor. None =
+    # MHA. Train/decode parity and the decode roofline gain are tested
+    # (tests/test_gqa.py) and measured (benchmarks/decode_bench.py).
+    num_kv_heads: Optional[int] = None
+    # features_only=True returns the backbone's ln_f output [B, T, D]
+    # instead of logits, for the fused chunked cross-entropy
+    # (ops/fused_ce.py): the head matmul then happens INSIDE the loss,
+    # chunk-by-chunk, and [B, T, V] logits never materialize. The head's
+    # params are untouched (init with the default model so they exist);
+    # toggle with ``model.copy(features_only=True)`` — flax module
+    # attributes are config, not state, so the param tree is shared.
+    features_only: bool = False
 
     @nn.compact
     def __call__(self, tokens, train: bool = False):
@@ -463,9 +545,12 @@ class TransformerLM(nn.Module):
                 decode=self.decode,
                 cache_len=self.max_len if self.decode else 0,
                 rope=rope,
+                num_kv_heads=self.num_kv_heads,
                 name=f"Block_{i}",
             )(x)
         x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
+        if self.features_only:
+            return x
         return VocabHead(self.vocab_size, self.dtype, name="head")(x)
 
 

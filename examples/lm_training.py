@@ -80,6 +80,17 @@ def main():
     ap.add_argument("--rope", action="store_true",
                     help="rotary position embeddings instead of the "
                          "sinusoidal table")
+    ap.add_argument("--text", default=None, metavar="DIR",
+                    help="train on REAL text: byte-tokenize every text "
+                         "file under DIR (vocab 256, doc-separated), "
+                         "hold out 5%% of rows, report held-out "
+                         "perplexity, and print a decoded sample "
+                         "(VERDICT r4 next #4). Overrides --n/--vocab.")
+    ap.add_argument("--max-mb", type=float, default=8.0,
+                    help="with --text: corpus size cap in MB")
+    ap.add_argument("--kv-heads", type=int, default=None,
+                    help="grouped-query attention: KV heads shared by "
+                         "heads/kv_heads query heads each (default MHA)")
     args = ap.parse_args()
 
     import jax
@@ -101,8 +112,24 @@ def main():
         axes.setdefault("dp", 1)
         axes.setdefault("ep", args.ep)
 
-    tokens = synthetic_corpus(args.n, args.seq_len, args.vocab)
-    ds = PartitionedDataset.from_arrays({"tokens": tokens}, num_partitions=1)
+    holdout = None
+    if args.text:
+        from distkeras_tpu.data.text import VOCAB, text_dataset
+
+        args.vocab = VOCAB
+        ds, holdout = text_dataset(
+            args.text, args.seq_len,
+            max_bytes=int(args.max_mb * 1e6),
+        )
+        tokens = np.asarray(ds.column("tokens"))
+        print(f"text corpus: {args.text} -> {len(tokens)} train + "
+              f"{holdout.num_rows if holdout else 0} holdout sequences "
+              f"of {args.seq_len} bytes")
+    else:
+        tokens = synthetic_corpus(args.n, args.seq_len, args.vocab)
+        ds = PartitionedDataset.from_arrays(
+            {"tokens": tokens}, num_partitions=1
+        )
 
     if moe:
         model = get_model(
@@ -122,6 +149,7 @@ def main():
             attention="ring" if args.sp > 1 else "standard",
             seq_axis="sp", tp_size=args.tp, tp_axis="tp",
             pos_emb="rope" if args.rope else "sinusoidal",
+            num_kv_heads=args.kv_heads,
         )
     trainer = LMTrainer(
         model, axes=axes, batch_size=args.batch_size, num_epoch=args.epochs,
@@ -132,6 +160,39 @@ def main():
         microbatches=args.microbatches,
     )
     trained = trainer.train(ds)
+
+    if args.text:
+        from distkeras_tpu.data.text import decode
+        from distkeras_tpu.evaluators import PerplexityEvaluator
+
+        if holdout is not None:
+            ppl = PerplexityEvaluator(
+                trained, batch_size=min(args.batch_size, holdout.num_rows)
+            ).evaluate(holdout)
+            print(f"held-out perplexity: {ppl:.2f} "
+                  f"(uniform-byte floor 256; "
+                  f"bits/byte {np.log2(ppl):.2f})")
+        # a decoded continuation of real text is the credibility check a
+        # token-id dump can't be
+        n_new = args.sample or 160
+        Tp = min(args.seq_len - n_new, args.seq_len // 2)
+        if Tp >= 1:
+            prompt = tokens[:1, :Tp]
+            out = trained.generate(prompt, max_new_tokens=n_new,
+                                   temperature=args.temperature)
+            print("--- prompt (tail) ---")
+            print(decode(prompt[0, -200:]))
+            print("--- model continuation ---")
+            print(decode(out[0, Tp:]))
+        first, last = (trainer.history[0]["loss"],
+                       trainer.history[-1]["loss"])
+        rate = (len(trainer.history) * args.batch_size * args.seq_len
+                / trainer.get_training_time())
+        print(f"mesh={axes} loss {first:.3f} -> {last:.3f} "
+              f"(uniform-byte floor {np.log(256):.3f}) | "
+              f"{rate:,.0f} tokens/sec")
+        assert last < first, "loss did not decrease"
+        return
 
     if args.sample:
         # inference story (VERDICT r3 #8): prompt with the first period of

