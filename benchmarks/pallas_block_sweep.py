@@ -60,18 +60,26 @@ def main():
     v = jnp.asarray(rng.normal(size=(B, T, H, hd)) * 0.1, jnp.bfloat16)
 
     def make_step(attn):
-        def one(c, _):
+        # the carry feeds THROUGH q each iteration (tiny data-dependent
+        # perturbation), so the attention+grad can't be hoisted out of
+        # the scan as loop-invariant and every iteration really runs
+        # (r5 review: a closure version here had zero dependence on the
+        # scan carry and measured hoisted code)
+        def one(carry, _):
+            c, q, k, v = carry
+
             def loss(q, k, v):
                 return jnp.sum(attn(q, k, v).astype(jnp.float32) * 1e-3)
 
             l, grads = jax.value_and_grad(loss, argnums=(0, 1, 2))(q, k, v)
-            return c + l + sum(jnp.sum(g.astype(jnp.float32)) * 0.0
-                               for g in grads), None
+            q = q + (l * 1e-6).astype(q.dtype)
+            return (c + l, q, k, v), None
 
         @jax.jit
         def step(q, k, v):
-            c, _ = jax.lax.scan(one, jnp.zeros((), jnp.float32), None,
-                                length=W)
+            (c, _, _, _), _ = jax.lax.scan(
+                one, (jnp.zeros((), jnp.float32), q, k, v), None, length=W
+            )
             return c
 
         return step

@@ -141,6 +141,8 @@ def _bwd(chunk, res, g):
         # recompute this chunk's logits (cheaper than having stored them:
         # one matmul vs N x V of HBM), then the softmax cotangent
         logits = _logits(xc, kernel, bias, x.dtype)
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        ll = jnp.take_along_axis(logits, lc[:, None], axis=1)[:, 0]
         p = jax.nn.softmax(logits, axis=-1)
         scale = (wc * g)[:, None]
         dl = p * scale
@@ -158,17 +160,23 @@ def _bwd(chunk, res, g):
             preferred_element_type=jnp.float32,
         )
         db = db + jnp.sum(dl, axis=0)
-        return (dk, db), dxc
+        # d loss / d weights[i] is the row's own CE (the loss is linear
+        # in weights) — free here since lse/ll are already in hand;
+        # returning None instead would silently zero a caller that
+        # differentiates through learned row weights (r5 review)
+        dwc = (lse - ll) * g
+        return (dk, db), (dxc, dwc)
 
     z = _vma_zero(x, kernel, bias, labels, weights, g)
-    (dk, db), dxs = jax.lax.scan(
+    (dk, db), (dxs, dws) = jax.lax.scan(
         body,
         (jnp.zeros((D, V), jnp.float32) + z, jnp.zeros((V,), jnp.float32) + z),
         (xs, ls, ws),
     )
     dx = dxs.reshape(nc * C, D)[: x.shape[0]]
+    dw = dws.reshape(nc * C)[: x.shape[0]].astype(weights.dtype)
     # padded rows have weight 0 -> their dl is exactly 0; no correction
-    return dx, dk.astype(kernel.dtype), db.astype(bias.dtype), None, None
+    return dx, dk.astype(kernel.dtype), db.astype(bias.dtype), None, dw
 
 
 fused_linear_softmax_ce.defvjp(_fwd, _bwd)

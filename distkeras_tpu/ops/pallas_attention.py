@@ -374,11 +374,11 @@ def supports(T: int, hd: int, block: int = DEFAULT_BLOCK,
 
 # auto-select candidates, in preference order, justified by the on-chip
 # sweep at the flagship attention shape (B8/H8/T2048/hd256, value+grad,
-# benchmarks/pallas_block_sweep.py → BASELINE.md): 512 = 13.84 ms/step,
-# 1024 = 13.81 (tied within noise, and unreachable anyway — any T that
-# 1024 divides, 512 divides first), 256 = 16.82 (+21%), 128 = 35.30
-# (worse than the blocked kernel: grid overhead swamps the tile skip).
-BLOCK_CANDIDATES = (512, 256, 128)
+# benchmarks/pallas_block_sweep.py → BASELINE.md): 1024 = 13.14 ms/step,
+# 512 = 13.51 (+2.8%), 256 = 14.73 (+12%), 128 = 19.31 (≈ the blocked
+# kernel: grid overhead swamps the tile skip). Largest-first, so T=2048
+# runs at 1024 while T=1536 (not divisible by 1024) falls to 512.
+BLOCK_CANDIDATES = (1024, 512, 256, 128)
 
 
 def choose_block(T: int, hd: int, itemsize: int = 2,

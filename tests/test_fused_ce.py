@@ -125,3 +125,18 @@ def test_param_tree_unchanged_by_features_only():
     feats = model.copy(features_only=True).apply(full, tok)
     assert feats.shape == (1, 8, 32)
     assert "head" in full["params"]  # init keeps the head
+
+
+def test_weights_gradient_is_per_row_ce():
+    """d loss / d weights[i] == CE_i (the loss is linear in weights);
+    r5 review: the first VJP returned None here, silently zeroing any
+    caller that differentiates through learned row weights."""
+    x, kernel, bias, labels, weights = _problem()
+    gw = jax.grad(
+        lambda w: fused_linear_softmax_ce(x, kernel, bias, labels, w, 32)
+    )(weights)
+    gw_ref = jax.grad(
+        lambda w: _ref_sum(x, kernel, bias, labels, w)
+    )(weights)
+    np.testing.assert_allclose(np.asarray(gw), np.asarray(gw_ref),
+                               rtol=1e-4, atol=1e-4)

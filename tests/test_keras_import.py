@@ -161,9 +161,9 @@ def test_gru_predictions_match_keras():
 def test_unsupported_layers_raise_with_names():
     km = keras.Sequential([
         keras.layers.Input((8, 8, 3)),
-        keras.layers.SeparableConv2D(8, 3),
+        keras.layers.Conv2DTranspose(8, 3),
     ])
-    with pytest.raises(ValueError, match="SeparableConv2D"):
+    with pytest.raises(ValueError, match="Conv2DTranspose"):
         from_keras(km)
 
 
@@ -452,3 +452,163 @@ def test_train_mode_rejects_dropout_noise_shape():
     from_keras(km)  # inference import: fine
     with pytest.raises(ValueError, match="noise_shape"):
         from_keras(km, train_mode=True)
+
+
+# ---------------------------------------------------------------------------
+# VERDICT r4 next #8: the remaining common Keras layers
+# ---------------------------------------------------------------------------
+
+
+def test_simplernn_predictions_match_keras():
+    for return_sequences in (False, True):
+        km = keras.Sequential([
+            keras.layers.Input((10, 5)),
+            keras.layers.SimpleRNN(12,
+                                   return_sequences=return_sequences),
+            keras.layers.Dense(3),
+        ])
+        model = from_keras(km)
+        x = np.random.default_rng(11).normal(size=(6, 10, 5)).astype(
+            np.float32)
+        np.testing.assert_allclose(
+            model.predict(x), km.predict(x, verbose=0),
+            rtol=1e-4, atol=1e-5,
+            err_msg=f"return_sequences={return_sequences}",
+        )
+
+
+def test_global_pooling_match_keras():
+    km = keras.Sequential([
+        keras.layers.Input((8, 8, 3)),
+        keras.layers.Conv2D(4, 3, activation="relu"),
+        keras.layers.GlobalAveragePooling2D(),
+        keras.layers.Dense(2),
+    ])
+    model = from_keras(km)
+    x = np.random.default_rng(12).normal(size=(5, 8, 8, 3)).astype(
+        np.float32)
+    np.testing.assert_allclose(model.predict(x), km.predict(x, verbose=0),
+                               rtol=1e-4, atol=1e-5)
+
+    km2 = keras.Sequential([
+        keras.layers.Input((8, 8, 3)),
+        keras.layers.GlobalMaxPooling2D(),
+    ])
+    model2 = from_keras(km2)
+    np.testing.assert_allclose(model2.predict(x),
+                               km2.predict(x, verbose=0),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_layernorm_matches_keras():
+    for center, scale in ((True, True), (False, True), (True, False)):
+        km = keras.Sequential([
+            keras.layers.Input((7,)),
+            keras.layers.Dense(9, activation="relu"),
+            keras.layers.LayerNormalization(center=center, scale=scale),
+            keras.layers.Dense(3),
+        ])
+        km.layers[1].set_weights([
+            w + 0.1 for w in km.layers[1].get_weights()
+        ])  # non-trivial gamma/beta
+        model = from_keras(km)
+        x = np.random.default_rng(13).normal(size=(6, 7)).astype(
+            np.float32)
+        np.testing.assert_allclose(
+            model.predict(x), km.predict(x, verbose=0),
+            rtol=1e-4, atol=1e-5, err_msg=f"center={center} scale={scale}",
+        )
+
+
+def test_depthwise_conv_matches_keras():
+    for mult in (1, 2):
+        km = keras.Sequential([
+            keras.layers.Input((10, 10, 3)),
+            keras.layers.DepthwiseConv2D(
+                3, depth_multiplier=mult, activation="relu"
+            ),
+            keras.layers.GlobalAveragePooling2D(),
+        ])
+        model = from_keras(km)
+        x = np.random.default_rng(14).normal(size=(4, 10, 10, 3)).astype(
+            np.float32)
+        np.testing.assert_allclose(
+            model.predict(x), km.predict(x, verbose=0),
+            rtol=1e-4, atol=1e-5, err_msg=f"depth_multiplier={mult}",
+        )
+
+
+def test_separable_conv_matches_keras():
+    for mult in (1, 2):
+        km = keras.Sequential([
+            keras.layers.Input((10, 10, 3)),
+            keras.layers.SeparableConv2D(
+                6, 3, depth_multiplier=mult, activation="relu",
+                padding="same",
+            ),
+            keras.layers.GlobalMaxPooling2D(),
+        ])
+        model = from_keras(km)
+        x = np.random.default_rng(15).normal(size=(4, 10, 10, 3)).astype(
+            np.float32)
+        np.testing.assert_allclose(
+            model.predict(x), km.predict(x, verbose=0),
+            rtol=1e-4, atol=1e-5, err_msg=f"depth_multiplier={mult}",
+        )
+
+
+def test_new_layers_export_roundtrip():
+    """Import AND export (VERDICT r4 next #8): the new layer vocabulary
+    round-trips through to_keras with predictions intact."""
+    from distkeras_tpu.utils.keras_import import to_keras
+
+    km = keras.Sequential([
+        keras.layers.Input((10, 10, 3)),
+        keras.layers.SeparableConv2D(6, 3, padding="same"),
+        keras.layers.DepthwiseConv2D(3),
+        keras.layers.GlobalAveragePooling2D(),
+        keras.layers.LayerNormalization(),
+        keras.layers.Dense(4),
+    ])
+    model = from_keras(km)
+    x = np.random.default_rng(16).normal(size=(4, 10, 10, 3)).astype(
+        np.float32)
+    km2 = to_keras(model, example_input=x)
+    np.testing.assert_allclose(
+        km2.predict(x, verbose=0), model.predict(x),
+        rtol=1e-4, atol=1e-5,
+    )
+
+
+def test_simplernn_export_roundtrip():
+    from distkeras_tpu.utils.keras_import import to_keras
+
+    km = keras.Sequential([
+        keras.layers.Input((10, 5)),
+        keras.layers.SimpleRNN(8, return_sequences=True),
+        keras.layers.SimpleRNN(6),
+        keras.layers.Dense(3),
+    ])
+    model = from_keras(km)
+    x = np.random.default_rng(17).normal(size=(6, 10, 5)).astype(
+        np.float32)
+    km2 = to_keras(model, example_input=x)
+    np.testing.assert_allclose(
+        km2.predict(x, verbose=0), model.predict(x),
+        rtol=1e-4, atol=1e-5,
+    )
+
+
+def test_strict_defaults_still_raise_on_new_layers():
+    km = keras.Sequential([
+        keras.layers.Input((10, 5)),
+        keras.layers.SimpleRNN(8, go_backwards=True),
+    ])
+    with pytest.raises(ValueError, match="go_backwards"):
+        from_keras(km)
+    km = keras.Sequential([
+        keras.layers.Input((8, 8, 3)),
+        keras.layers.DepthwiseConv2D(3, dilation_rate=(2, 2)),
+    ])
+    with pytest.raises(ValueError, match="dilation_rate"):
+        from_keras(km)
