@@ -72,7 +72,11 @@ def main():
                 return jnp.sum(attn(q, k, v).astype(jnp.float32) * 1e-3)
 
             l, grads = jax.value_and_grad(loss, argnums=(0, 1, 2))(q, k, v)
-            q = q + (l * 1e-6).astype(q.dtype)
+            # feed loss AND a grad through the carry: an unconsumed (or
+            # 0-multiplied) grads tree gets dead-code-eliminated and the
+            # "value+grad" bench times the forward only (r5 review)
+            q = (q + (l * 1e-6).astype(q.dtype)
+                 + (grads[0] * 1e-6).astype(q.dtype))
             return (c + l, q, k, v), None
 
         @jax.jit

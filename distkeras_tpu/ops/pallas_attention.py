@@ -60,6 +60,19 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def _out_struct(shape, dtype, like):
+    """Output aval for a ``pallas_call``, carrying ``like``'s vma
+    (varying-over-mesh-axes) type: under ``shard_map(check_vma=True)``
+    every output aval must state how it varies, and a plain
+    ShapeDtypeStruct is rejected — which made the kernel unusable inside
+    the sharded LM step (found the first time LMTrainer ran on real TPU
+    with the pallas auto-select, r5)."""
+    vma = getattr(jax.typeof(like), "vma", None)
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
 # ---------------------------------------------------------------------------
 # forward: grid (BH, nq, nk), online softmax state in scratch
 # ---------------------------------------------------------------------------
@@ -140,8 +153,8 @@ def _fwd(q3, k3, v3, block: int, scale: float):
                          memory_space=pltpu.VMEM),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((BH, T, hd), q3.dtype),
-            jax.ShapeDtypeStruct((BH, T, LSE_LANES), jnp.float32),
+            _out_struct((BH, T, hd), q3.dtype, q3),
+            _out_struct((BH, T, LSE_LANES), jnp.float32, q3),
         ],
         scratch_shapes=[
             pltpu.VMEM((block, hd), jnp.float32),
@@ -288,7 +301,7 @@ def _bwd(q3, k3, v3, out, lse, do3, block: int, scale: float):
         ],
         out_specs=pl.BlockSpec((1, block, hd), q_row_idx,
                                memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((BH, T, hd), q3.dtype),
+        out_shape=_out_struct((BH, T, hd), q3.dtype, q3),
         scratch_shapes=[pltpu.VMEM((block, hd), jnp.float32)],
         interpret=_interpret(),
     )(q3, k3, v3, do3, out, lse)
@@ -320,8 +333,8 @@ def _bwd(q3, k3, v3, out, lse, do3, block: int, scale: float):
                          memory_space=pltpu.VMEM),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((BH, T, hd), k3.dtype),
-            jax.ShapeDtypeStruct((BH, T, hd), v3.dtype),
+            _out_struct((BH, T, hd), k3.dtype, k3),
+            _out_struct((BH, T, hd), v3.dtype, v3),
         ],
         scratch_shapes=[
             pltpu.VMEM((block, hd), jnp.float32),
@@ -374,11 +387,13 @@ def supports(T: int, hd: int, block: int = DEFAULT_BLOCK,
 
 # auto-select candidates, in preference order, justified by the on-chip
 # sweep at the flagship attention shape (B8/H8/T2048/hd256, value+grad,
-# benchmarks/pallas_block_sweep.py → BASELINE.md): 1024 = 13.14 ms/step,
-# 512 = 13.51 (+2.8%), 256 = 14.73 (+12%), 128 = 19.31 (≈ the blocked
-# kernel: grid overhead swamps the tile skip). Largest-first, so T=2048
-# runs at 1024 while T=1536 (not divisible by 1024) falls to 512.
-BLOCK_CANDIDATES = (1024, 512, 256, 128)
+# benchmarks/pallas_block_sweep.py → BASELINE.md): 512 = 13.51 ms/step,
+# 256 = 14.73 (+9%), 128 = 19.31 (≈ the blocked kernel: grid overhead
+# swamps the tile skip). block=1024 measured 13.14 standalone (-2.8%)
+# but its dkv backward kernel needs 16.95 MB of scoped VMEM — over the
+# 16 MB limit — inside the full sharded training step (compile-time OOM
+# in the LMTrainer path, r5), so 512 is the largest ROBUST block.
+BLOCK_CANDIDATES = (512, 256, 128)
 
 
 def choose_block(T: int, hd: int, itemsize: int = 2,

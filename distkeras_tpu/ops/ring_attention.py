@@ -126,6 +126,53 @@ def _varying_zeros(q, shapes_fills, axis_name):
     )
 
 
+def _merge_pair(stats, o_pair, lse_pair):
+    """Fold one pair's normalized output + log-sum-exp into running
+    online-softmax stats — the exact flash merge: the pair contributes
+    total softmax mass ``exp(lse - m_new)`` and its normalized rows enter
+    at that weight."""
+    o, m, l = stats
+    lse_t = lse_pair.transpose(0, 2, 1)  # [B, C, H] -> [B, H, C]
+    m_new = jnp.maximum(m, lse_t)
+    corr = jnp.exp(m - m_new)
+    w = jnp.exp(lse_t - m_new)
+    l_new = l * corr + w
+    o_new = (o * corr.transpose(0, 2, 1)[..., None]
+             + o_pair.astype(jnp.float32)
+             * w.transpose(0, 2, 1)[..., None])
+    return o_new, m_new, l_new
+
+
+def _pair_kernel_block(C: int, hd: int, dtype):
+    """Block for the fused Pallas pair kernel, or None to use the
+    blocked-einsum inner loop. Auto: TPU only (interpret mode would be
+    slow in CPU tests) and a legal block must exist. Env override
+    ``DK_RING_PALLAS``: '1' forces it anywhere (tests use interpret
+    mode), '0' disables. Why this exists: the pure-JAX inner attend
+    measured 5.8-19.2 TF/s effective on the v5e (3-10% of peak — a
+    dependent chain of small XLA ops drowns in per-op latency); the
+    fused pair kernel is 1.67x/1.77x/2.33x faster at C=512/1024/2048
+    (VERDICT r4 next #2; BASELINE.md · ring inner attend)."""
+    import os
+
+    from distkeras_tpu.ops.pallas_pair import pair_supports
+
+    flag = os.environ.get("DK_RING_PALLAS", "auto")
+    if flag == "0":
+        return None
+    b = pair_supports(C, C, hd, itemsize=jnp.dtype(dtype).itemsize)
+    if b is None:
+        if flag == "1":
+            raise ValueError(
+                f"DK_RING_PALLAS=1 but no legal pair block for C={C}, "
+                f"hd={hd} (need hd % 128 == 0 and a block dividing C)"
+            )
+        return None
+    if flag != "1" and jax.default_backend() != "tpu":
+        return None
+    return b
+
+
 def _attend(stats, qf, kc, vc, *, causal: bool, bk: int):
     """Streamed attention of one chunk pair, folded into running online-
     softmax stats ``(o [B,C,H,hd] f32, m [B,H,C] f32, l [B,H,C] f32)``.
@@ -213,7 +260,15 @@ def _ring_zigzag(q, k, v, axis_name, N):
         axis_name,
     )
 
-    attend = functools.partial(_attend, bk=bk)
+    pb = _pair_kernel_block(C, hd, q.dtype)
+    if pb is not None:
+        from distkeras_tpu.ops.pallas_pair import pallas_pair_attention
+
+        def attend(stats, qf, kc, vc, causal):
+            o_pair, lse = pallas_pair_attention(qf, kc, vc, causal, pb)
+            return _merge_pair(stats, o_pair, lse)
+    else:
+        attend = functools.partial(_attend, bk=bk)
 
     # step 0 — the only masked work: both local diagonal chunks, plus the
     # always-full (late q, early kv) pair

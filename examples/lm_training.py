@@ -68,6 +68,9 @@ def main():
     ap.add_argument("--heads", type=int, default=4)
     ap.add_argument("--batch-size", type=int, default=32)
     ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--lr", type=float, default=3e-3,
+                    help="adam learning rate (flagship-size models want "
+                         "~3e-4; the small default model is happy hotter)")
     ap.add_argument("--metrics", default=None, help="JSONL metrics path")
     ap.add_argument("--sample", type=int, default=0, metavar="N",
                     help="after training, greedy-decode N tokens from a "
@@ -153,7 +156,7 @@ def main():
         )
     trainer = LMTrainer(
         model, axes=axes, batch_size=args.batch_size, num_epoch=args.epochs,
-        worker_optimizer="adam", learning_rate=3e-3,
+        worker_optimizer="adam", learning_rate=args.lr,
         metrics_path=args.metrics,
         # passed through unconditionally: the trainer's own validation
         # tells the user the flag needs a pp axis

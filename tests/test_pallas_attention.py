@@ -114,13 +114,15 @@ def test_choose_block_flexes_to_divisors():
     non-default block instead of silently dropping to the blocked kernel."""
     from distkeras_tpu.ops.pallas_attention import choose_block
 
-    assert choose_block(2048, 256) == 1024  # sweep-fastest wins
+    # 512 first: fastest ROBUST block (1024 is ~3% faster standalone but
+    # VMEM-OOMs the dkv backward inside the full training step)
+    assert choose_block(2048, 256) == 512
     assert choose_block(1536, 256) == 512   # 1536 = 3 x 512
     assert choose_block(768, 256) == 256    # 768 = 3 x 256
-    assert choose_block(3072, 256) == 1024  # 3 x 1024
-    assert choose_block(6144, 256) == 1024
+    assert choose_block(3072, 256) == 512
+    assert choose_block(6144, 256) == 512
     assert choose_block(1280, 256) == 256   # 1280 = 5 x 256
-    assert choose_block(1024, 256) == 1024
+    assert choose_block(1024, 256) == 512
     assert choose_block(896, 256) == 128    # 7 x 128
     assert choose_block(1000, 256) is None  # no candidate divides
     assert choose_block(2048, 64) is None   # sub-lane head dim still out
