@@ -60,6 +60,19 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def _call_kwargs(block: int) -> dict:
+    """Extra pallas_call kwargs by block size: blocks above the default
+    need the scoped-VMEM cap raised — the dkv backward at block=1024
+    wants 16.95 MB against the default 16 MB limit inside the full
+    training step (it compiled standalone, just under the cliff), and
+    the cap is a budget, not an allocation, so raising it only for the
+    big blocks leaves the proven 512-path compilation untouched."""
+    if block > DEFAULT_BLOCK:
+        return {"compiler_params": pltpu.CompilerParams(
+            vmem_limit_bytes=64 * 1024 * 1024)}
+    return {}
+
+
 def _out_struct(shape, dtype, like):
     """Output aval for a ``pallas_call``, carrying ``like``'s vma
     (varying-over-mesh-axes) type: under ``shard_map(check_vma=True)``
@@ -162,6 +175,7 @@ def _fwd(q3, k3, v3, block: int, scale: float):
             pltpu.VMEM((block, 1), jnp.float32),
         ],
         interpret=_interpret(),
+        **_call_kwargs(block),
     )(q3, k3, v3)
 
 
@@ -304,6 +318,7 @@ def _bwd(q3, k3, v3, out, lse, do3, block: int, scale: float):
         out_shape=_out_struct((BH, T, hd), q3.dtype, q3),
         scratch_shapes=[pltpu.VMEM((block, hd), jnp.float32)],
         interpret=_interpret(),
+        **_call_kwargs(block),
     )(q3, k3, v3, do3, out, lse)
 
     def q_col_idx(b, j, i):  # dkv grid: q/do/o/lse blocks clamp to diag
@@ -341,6 +356,7 @@ def _bwd(q3, k3, v3, out, lse, do3, block: int, scale: float):
             pltpu.VMEM((block, hd), jnp.float32),
         ],
         interpret=_interpret(),
+        **_call_kwargs(block),
     )(q3, k3, v3, do3, out, lse)
     return dq, dk, dv
 
@@ -387,12 +403,15 @@ def supports(T: int, hd: int, block: int = DEFAULT_BLOCK,
 
 # auto-select candidates, in preference order, justified by the on-chip
 # sweep at the flagship attention shape (B8/H8/T2048/hd256, value+grad,
-# benchmarks/pallas_block_sweep.py → BASELINE.md): 512 = 13.51 ms/step,
-# 256 = 14.73 (+9%), 128 = 19.31 (≈ the blocked kernel: grid overhead
-# swamps the tile skip). block=1024 measured 13.14 standalone (-2.8%)
-# but its dkv backward kernel needs 16.95 MB of scoped VMEM — over the
-# 16 MB limit — inside the full sharded training step (compile-time OOM
-# in the LMTrainer path, r5), so 512 is the largest ROBUST block.
+# benchmarks/pallas_block_sweep.py → BASELINE.md): 512 = 15.80 ms/step
+# (1.38x vs blocked), 256 = 17.95, 128 = 26.44 (worse than blocked:
+# grid overhead swamps the tile skip). block=1024 measured 10.57
+# standalone (2.06x); its dkv backward used to compile-OOM the 16 MB
+# scoped-VMEM limit inside the full training step — fixed by
+# _call_kwargs raising the cap for big blocks (full-step compile
+# re-verified) — and it is promoted to first preference only where the
+# full-step throughput measurement confirms the standalone win (see
+# BASELINE.md; the sweep table is the evidence trail).
 BLOCK_CANDIDATES = (512, 256, 128)
 
 

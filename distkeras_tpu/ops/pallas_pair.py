@@ -42,6 +42,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from distkeras_tpu.ops.pallas_attention import (
     LSE_LANES,
+    _call_kwargs,
     _from_bh,
     _interpret,
     _out_struct,
@@ -141,6 +142,7 @@ def _fwd(q3, k3, v3, block: int, causal: bool):
             pltpu.VMEM((block, 1), jnp.float32),
         ],
         interpret=_interpret(),
+        **_call_kwargs(block),
     )(q3, k3, v3)
 
 
@@ -293,6 +295,7 @@ def _bwd_impl(q3, k3, v3, out, lse, do3, dlse, block: int, causal: bool):
         out_shape=_out_struct((BH, Tq, hd), q3.dtype, q3),
         scratch_shapes=[pltpu.VMEM((block, hd), jnp.float32)],
         interpret=_interpret(),
+        **_call_kwargs(block),
     )(q3, k3, v3, do3, out, lse, dlse)
 
     qcspec = pl.BlockSpec((1, block, hd), q_col_idx,
@@ -315,6 +318,7 @@ def _bwd_impl(q3, k3, v3, out, lse, do3, dlse, block: int, causal: bool):
             pltpu.VMEM((block, hd), jnp.float32),
         ],
         interpret=_interpret(),
+        **_call_kwargs(block),
     )(q3, k3, v3, do3, out, lse, dlse)
     return dq, dk, dv
 
