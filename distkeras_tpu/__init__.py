@@ -27,7 +27,7 @@ metrics, profiling hooks, tensor/sequence parallelism (ring attention),
 and a real test suite.
 """
 
-__version__ = "0.1.0"
+__version__ = "0.5.0"
 
 from distkeras_tpu.data.dataset import PartitionedDataset  # noqa: F401
 from distkeras_tpu.models.wrapper import Model  # noqa: F401

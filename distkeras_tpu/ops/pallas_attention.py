@@ -406,12 +406,11 @@ def supports(T: int, hd: int, block: int = DEFAULT_BLOCK,
 # benchmarks/pallas_block_sweep.py → BASELINE.md): 512 = 15.80 ms/step
 # (1.38x vs blocked), 256 = 17.95, 128 = 26.44 (worse than blocked:
 # grid overhead swamps the tile skip). block=1024 measured 10.57
-# standalone (2.06x); its dkv backward used to compile-OOM the 16 MB
-# scoped-VMEM limit inside the full training step — fixed by
-# _call_kwargs raising the cap for big blocks (full-step compile
-# re-verified) — and it is promoted to first preference only where the
-# full-step throughput measurement confirms the standalone win (see
-# BASELINE.md; the sweep table is the evidence trail).
+# standalone (2.06x) and its old 16 MB scoped-VMEM compile-OOM is fixed
+# (_call_kwargs raises the cap for big blocks), but the FULL flagship
+# step measured ~1% SLOWER at 1024 than 512 (47,107 vs 47,559 tok/s,
+# same session) — the kernel's VMEM appetite costs the surrounding
+# program more than the bigger tiles gain — so 512 stays first.
 BLOCK_CANDIDATES = (512, 256, 128)
 
 

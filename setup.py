@@ -9,7 +9,7 @@ from setuptools import find_packages, setup
 
 setup(
     name="distkeras-tpu",
-    version="0.4.0",
+    version="0.5.0",
     description=(
         "TPU-native distributed deep learning: data-parallel trainers "
         "(DOWNPOUR, ADAG, EASGD/AEASGD/EAMSGD, DynSGD), partitioned-dataset "

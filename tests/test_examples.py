@@ -158,3 +158,24 @@ def test_lm_training_example_pp_smoke(monkeypatch, capsys):
     )
     out = capsys.readouterr().out
     assert "tokens/sec" in out and "pp" in out
+
+
+def test_lm_training_text_mode_smoke(monkeypatch, capsys, tmp_path):
+    """--text end-to-end on a tiny corpus: byte-tokenize, train with the
+    cosine schedule, report held-out perplexity, print a decoded
+    continuation (VERDICT r4 next #4)."""
+    (tmp_path / "a.py").write_text(
+        "def add(a, b):\n    return a + b\n" * 120
+    )
+    sys.path.insert(0, "examples")
+    run_example(
+        monkeypatch, "lm_training",
+        ["lm_training.py", "--text", str(tmp_path), "--seq-len", "64",
+         "--d-model", "32", "--heads", "2", "--layers", "2",
+         "--batch-size", "8", "--epochs", "2", "--lr", "1e-2",
+         "--lr-schedule", "cosine", "--sample", "16"],
+    )
+    out = capsys.readouterr().out
+    assert "held-out perplexity" in out
+    assert "model continuation" in out
+    assert "tokens/sec" in out
