@@ -15,12 +15,15 @@ import subprocess
 import sys
 
 CONFIGS = [
-    # (T, B, remat)
+    # (T, B, remat) — the B=8@4096 and B=2@16384 no-remat rows became
+    # trainable in r5 when the fused CE removed the [B, T, V] logits
     (2048, 8, "none"),
     (4096, 4, "none"),
+    (4096, 8, "none"),
     (8192, 2, "none"),
     (8192, 4, "block"),
     (16384, 1, "none"),
+    (16384, 2, "none"),
     (16384, 2, "block"),
 ]
 
