@@ -117,7 +117,7 @@ def _peak_flops():
 
 
 def lm_bench(D=2048, H=8, L=8, V=8192, B=8, T=2048, remat="none",
-             calls=4, ce_chunk=None):
+             calls=4, ce_chunk=None, pos_emb="sinusoidal"):
     """Flagship TransformerLM training throughput + MFU on one chip.
 
     Parameterized so the long-context sweep (``benchmarks/lm_scan.py``)
@@ -132,9 +132,12 @@ def lm_bench(D=2048, H=8, L=8, V=8192, B=8, T=2048, remat="none",
     W = 5  # optimizer steps per dispatch (scan window)
     # 'standard' auto-selects the Pallas causal-skip kernel on TPU
     # (~1.9x over the blocked kernel at this T), blocked elsewhere
+    # pos_emb='rope' matters at extreme T: the sinusoidal table is a
+    # [T, D] f32 compile-time constant (268 MB at T=32768) that the
+    # tunneled remote-compile path refuses to buffer; rope has no table
     model = get_model("transformer_lm", vocab_size=V, d_model=D,
                       num_heads=H, num_layers=L, max_len=T,
-                      attention="standard", remat=remat)
+                      attention="standard", remat=remat, pos_emb=pos_emb)
     toks = jnp.asarray(
         np.random.default_rng(0).integers(0, V, size=(W, B, T)), jnp.int32
     )
@@ -212,6 +215,8 @@ def lm_bench(D=2048, H=8, L=8, V=8192, B=8, T=2048, remat="none",
         if jax.default_backend() == "tpu" else None)
     kernel = f"pallas-causal{chosen}" if chosen else "blocked"
     tag = "" if remat == "none" else f"-remat:{remat}"
+    if pos_emb != "sinusoidal":
+        tag += f"-{pos_emb}"
     out = {
         "lm_tokens_per_sec_per_chip": round(steps * B * T / dt, 1),
         "lm_config": f"d{D}/h{H}/L{L}/v{V}/T{T}/b{B}-bf16-{kernel}"
