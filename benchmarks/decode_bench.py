@@ -29,14 +29,14 @@ import jax.numpy as jnp
 
 
 def bench(D=2048, H=8, L=8, V=8192, B=8, prompt_len=128, new_tokens=256,
-          kv_heads=None):
+          kv_heads=None, cache_dtype="model"):
     from distkeras_tpu.models import get_model
     from distkeras_tpu.models.transformer import generate
 
     T = prompt_len + new_tokens
     model = get_model("transformer_lm", vocab_size=V, d_model=D,
                       num_heads=H, num_layers=L, max_len=T,
-                      num_kv_heads=kv_heads)
+                      num_kv_heads=kv_heads, cache_dtype=cache_dtype)
     prompt = jnp.asarray(
         np.random.default_rng(0).integers(0, V, size=(B, prompt_len)),
         jnp.int32,
@@ -57,7 +57,9 @@ def bench(D=2048, H=8, L=8, V=8192, B=8, prompt_len=128, new_tokens=256,
         "decode_tokens_per_sec": round(calls * B * new_tokens / dt, 1),
         "config": f"d{D}/h{H}/L{L}/v{V}/b{B}-prompt{prompt_len}"
                   f"-new{new_tokens}-greedy-bf16"
-                  + (f"-gqa{kv_heads}" if kv_heads else "-mha"),
+                  + (f"-gqa{kv_heads}" if kv_heads else "-mha")
+                  + (f"-cache:{cache_dtype}"
+                     if cache_dtype != "model" else ""),
     }
     print(json.dumps(result), flush=True)
     del params, out
@@ -68,15 +70,17 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--B", type=int, default=8)
     ap.add_argument("--kv-heads", type=int, default=None)
+    ap.add_argument("--cache-dtype", choices=["model", "int8"],
+                    default="model")
     ap.add_argument("--sweep", action="store_true",
                     help="B in {8,16,32} x kv_heads in {None,2} grid")
     args = ap.parse_args()
     if args.sweep:
         for B in (8, 16, 32):
             for kv in (None, 2):
-                bench(B=B, kv_heads=kv)
+                bench(B=B, kv_heads=kv, cache_dtype=args.cache_dtype)
         return
-    bench(B=args.B, kv_heads=args.kv_heads)
+    bench(B=args.B, kv_heads=args.kv_heads, cache_dtype=args.cache_dtype)
 
 
 if __name__ == "__main__":

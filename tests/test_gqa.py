@@ -212,3 +212,61 @@ def test_gqa_decode_under_tensor_parallelism():
     np.testing.assert_allclose(
         np.asarray(out_tp), np.asarray(out_full), rtol=1e-4, atol=1e-5
     )
+
+
+def test_int8_cache_decode_close_to_bf16():
+    """cache_dtype='int8' (r5): per-row symmetric KV quantization — the
+    cached-decode logits must track the full-precision cache within
+    quantization tolerance, and the cache tensors must actually be int8
+    with per-row f32 scales."""
+    model, params = _model_and_params(num_kv_heads=2, seed=4)
+    rng = np.random.default_rng(4)
+    toks = jnp.asarray(rng.integers(0, 64, size=(2, 12)), jnp.int32)
+
+    def decode_logits(cache_dtype):
+        dm = model.clone(decode=True, cache_dtype=cache_dtype,
+                         parent=None)
+        out, st = dm.apply({"params": params["params"]}, toks,
+                           mutable=["cache"])
+        return out, st
+
+    full, _ = decode_logits("model")
+    q, st = decode_logits("int8")
+    np.testing.assert_allclose(
+        np.asarray(q), np.asarray(full), rtol=5e-2, atol=5e-2
+    )
+    cache = st["cache"]["Block_0"]["CausalSelfAttention_0"]
+    assert cache["cached_key"].dtype == jnp.int8
+    assert cache["key_scale"].dtype == jnp.float32
+    assert cache["cached_key"].shape == (2, 64, 2, 16)
+    assert cache["key_scale"].shape == (2, 64, 2)
+
+
+def test_int8_cache_generate_runs_and_matches_mostly():
+    """generate() with the int8 cache produces a sequence; on a random
+    (high-entropy) model argmax ties can flip under quantization, so
+    assert shape/validity plus agreement of the first decoded token
+    against prefill logits computed with the same quantized cache."""
+    model, params = _model_and_params(num_kv_heads=2, seed=5,
+                                      cache_dtype="int8")
+    rng = np.random.default_rng(5)
+    prompt = jnp.asarray(rng.integers(0, 64, size=(2, 7)), jnp.int32)
+    out = generate(model, params, prompt, max_new_tokens=6)
+    o = np.asarray(out)
+    assert o.shape == (2, 13)
+    assert ((o >= 0) & (o < 64)).all()
+    # greedy self-consistency THROUGH the quantized path: re-scoring the
+    # generated sequence with the quantized-cache prefill reproduces the
+    # next-token choices
+    dm = model.clone(decode=True, parent=None)
+    logits, _ = dm.apply({"params": params["params"]},
+                         jnp.asarray(o), mutable=["cache"])
+    pred = np.asarray(jnp.argmax(logits[:, :-1], axis=-1))
+    np.testing.assert_array_equal(pred[:, 6:12], o[:, 7:13])
+
+
+def test_unknown_cache_dtype_raises():
+    # fail-fast contract: the bad knob errors at the first forward (even
+    # a TRAINING init), not only when a decode clone later hits the cache
+    with pytest.raises(ValueError, match="cache_dtype"):
+        _model_and_params(seed=6, cache_dtype="fp4")
