@@ -267,8 +267,28 @@ def config6():
     }))
 
 
+def config7():
+    """Continuous-batching serving engine vs back-to-back static
+    generate() under a Poisson arrival trace with mixed output lengths
+    (benchmarks/serve_bench.py)."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import serve_bench
+
+    out = serve_bench.bench()
+    print(json.dumps({
+        "config": 7, "metric": "serving_continuous_batching_tokens_per_sec",
+        "value": out["serve_tokens_per_sec"],
+        "unit": "tokens/sec",
+        "static_baseline": out["static_tokens_per_sec"],
+        "speedup": out["speedup"],
+        "ttft_ms": out["ttft_ms"],
+        "model": out["config"],
+        "data": "synthetic-poisson-trace",
+    }))
+
+
 CONFIGS = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5,
-           6: config6}
+           6: config6, 7: config7}
 
 
 def main():

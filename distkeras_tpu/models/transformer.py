@@ -42,8 +42,9 @@ def apply_rope(x: jnp.ndarray, pos: jnp.ndarray) -> jnp.ndarray:
     ``x [B, T, H, hd]`` rotated by per-position angles — relative
     positions enter attention through the q·k product itself, so there is
     no additive table and no trained length ceiling beyond the cache.
-    ``pos [T]`` are GLOBAL positions (ring shards and decode steps pass
-    their offsets)."""
+    ``pos`` are GLOBAL positions (ring shards and decode steps pass their
+    offsets): ``[T]`` shared across the batch, or ``[B, T]`` per-row (the
+    continuous-batching engine's slots sit at independent depths)."""
     hd = x.shape[-1]
     if hd % 2:
         raise ValueError(
@@ -52,9 +53,13 @@ def apply_rope(x: jnp.ndarray, pos: jnp.ndarray) -> jnp.ndarray:
         )
     half = hd // 2
     freqs = 1.0 / (10000.0 ** (jnp.arange(half, dtype=jnp.float32) / half))
-    ang = pos.astype(jnp.float32)[:, None] * freqs[None, :]  # [T, half]
-    cos = jnp.cos(ang)[None, :, None, :]
-    sin = jnp.sin(ang)[None, :, None, :]
+    ang = pos.astype(jnp.float32)[..., None] * freqs  # [(B,) T, half]
+    if ang.ndim == 3:
+        cos = jnp.cos(ang)[:, :, None, :]
+        sin = jnp.sin(ang)[:, :, None, :]
+    else:
+        cos = jnp.cos(ang)[None, :, None, :]
+        sin = jnp.sin(ang)[None, :, None, :]
     x1 = x[..., :half].astype(jnp.float32)
     x2 = x[..., half:].astype(jnp.float32)
     return jnp.concatenate(
@@ -200,6 +205,14 @@ class CausalSelfAttention(nn.Module):
     # never round-trip HBM). Composes with GQA: kv_heads=2 + int8 is an
     # 8x smaller cache stream than the r4 MHA-bf16 baseline.
     cache_dtype: str = "model"
+    # per-row cache cursors (the continuous-batching serving engine,
+    # serving/engine.py): cache_index becomes a [B] vector, and writes /
+    # rope / the causal mask are applied at each row's own cursor — batch
+    # row b is a SLOT holding an independent sequence at its own depth,
+    # so finished slots can be refilled mid-flight without touching the
+    # others. Requires decode=True; the math per row is identical to the
+    # scalar-cursor path (parity-tested in tests/test_serving.py).
+    slot_cursor: bool = False
 
     _DENSE_MAX_T = 512  # short sequences: one fused dense block is fastest
 
@@ -245,15 +258,26 @@ class CausalSelfAttention(nn.Module):
                 "cache", "value_scale", jnp.ones, (B, L, Hk), jnp.float32
             )
         idx = self.variable(
-            "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
+            "cache", "cache_index",
+            lambda: jnp.zeros((B,) if self.slot_cursor else (), jnp.int32),
         )
-        cur = idx.value
+        cur = idx.value  # [] shared cursor, or [B] per-slot cursors
         if self.rope:
-            pos = cur + jnp.arange(T)
+            if self.slot_cursor:
+                pos = cur[:, None] + jnp.arange(T)[None]  # [B, T]
+            else:
+                pos = cur + jnp.arange(T)
             q = apply_rope(q, pos)
             k = apply_rope(k, pos)
 
         def put(cache, new):
+            if self.slot_cursor:
+                # each slot writes at its own cursor
+                return jax.vmap(
+                    lambda c, n, i: jax.lax.dynamic_update_slice(
+                        c, n, (i,) + (0,) * (c.ndim - 1)
+                    )
+                )(cache, new, cur)
             return jax.lax.dynamic_update_slice(
                 cache, new, (0, cur) + (0,) * (cache.ndim - 2)
             )
@@ -288,9 +312,14 @@ class CausalSelfAttention(nn.Module):
         s = jnp.einsum(
             "bqkgd,blkd->bkgql", qg, keys
         ).astype(jnp.float32) * scale
-        q_pos = cur + jnp.arange(T)
-        mask = jnp.arange(L)[None, :] <= q_pos[:, None]  # [T, L]
-        s = jnp.where(mask[None, None, None], s, -1e30)
+        if self.slot_cursor:
+            q_pos = cur[:, None] + jnp.arange(T)[None]  # [B, T]
+            mask = jnp.arange(L)[None, None, :] <= q_pos[..., None]
+            s = jnp.where(mask[:, None, None], s, -1e30)  # [B,1,1,T,L]
+        else:
+            q_pos = cur + jnp.arange(T)
+            mask = jnp.arange(L)[None, :] <= q_pos[:, None]  # [T, L]
+            s = jnp.where(mask[None, None, None], s, -1e30)
         p = jax.nn.softmax(s, axis=-1)
         out = jnp.einsum("bkgql,blkd->bqkgd", p.astype(self.dtype), vals)
         return out.reshape(B, T, H, hd)
@@ -310,6 +339,11 @@ class CausalSelfAttention(nn.Module):
             raise ValueError(
                 f"Unknown cache_dtype '{self.cache_dtype}'. "
                 "Known: model, int8"
+            )
+        if self.slot_cursor and not self.decode:
+            raise ValueError(
+                "slot_cursor=True (per-row cache cursors) only makes "
+                "sense with decode=True"
             )
         Hk = self.num_kv_heads or H
         if H % Hk != 0:
@@ -451,6 +485,7 @@ class Block(nn.Module):
     rope: bool = False
     num_kv_heads: Optional[int] = None  # GQA; None = MHA
     cache_dtype: str = "model"  # decode KV cache: 'model' | 'int8'
+    slot_cursor: bool = False  # per-row cache cursors (serving engine)
 
     @nn.compact
     def __call__(self, x):
@@ -462,6 +497,7 @@ class Block(nn.Module):
             decode=self.decode, cache_len=self.cache_len, rope=self.rope,
             num_kv_heads=self.num_kv_heads,
             cache_dtype=self.cache_dtype,
+            slot_cursor=self.slot_cursor,
         )(h)
         h = nn.LayerNorm(dtype=self.dtype)(x)
         if self.moe_experts > 0:
@@ -541,6 +577,11 @@ class TransformerLM(nn.Module):
     # cache stream again on top of GQA; decode-parity tested at ~1e-2
     # logit tolerance)
     cache_dtype: str = "model"
+    # per-row cache cursors for the continuous-batching serving engine
+    # (serving/engine.py): each batch row is an independent slot with its
+    # own cursor — prefills scatter into a slot, EOS'd slots refill
+    # without touching neighbours. decode=True only.
+    slot_cursor: bool = False
     # features_only=True returns the backbone's ln_f output [B, T, D]
     # instead of logits, for the fused chunked cross-entropy
     # (ops/fused_ce.py): the head matmul then happens INSIDE the loss,
@@ -559,6 +600,11 @@ class TransformerLM(nn.Module):
         if self.pos_emb not in ("sinusoidal", "rope"):
             raise ValueError(
                 f"Unknown pos_emb '{self.pos_emb}'. Known: sinusoidal, rope"
+            )
+        if self.slot_cursor and not self.decode:
+            raise ValueError(
+                "slot_cursor=True (per-row cache cursors) requires "
+                "decode=True"
             )
         rope = self.pos_emb == "rope"
         # explicit submodule names: the pipeline-parallel path addresses
@@ -580,15 +626,24 @@ class TransformerLM(nn.Module):
                 local_pos = local_pos + offset
             if self.decode:
                 # decode steps see only the new tokens; their positions
-                # start at the running cursor (kept with the KV caches)
+                # start at the running cursor (kept with the KV caches) —
+                # a scalar, or one cursor per slot under slot_cursor
                 pos_idx = self.variable(
-                    "cache", "pos_index", lambda: jnp.zeros((), jnp.int32)
+                    "cache", "pos_index",
+                    lambda: jnp.zeros(
+                        (x.shape[0],) if self.slot_cursor else (),
+                        jnp.int32,
+                    ),
                 )
-                local_pos = local_pos + pos_idx.value
+                if self.slot_cursor:
+                    local_pos = local_pos[None, :] + pos_idx.value[:, None]
+                else:
+                    local_pos = local_pos + pos_idx.value
                 pos_idx.value = pos_idx.value + x.shape[1]
-            x = x + jnp.take(
-                pos_table, local_pos, axis=0
-            )[None].astype(self.dtype)
+            taken = jnp.take(pos_table, local_pos, axis=0)
+            if taken.ndim == 2:  # shared positions: broadcast over batch
+                taken = taken[None]
+            x = x + taken.astype(self.dtype)
         # nn.remat is param-structure-transparent: checkpoints keep the
         # same tree either way, so remat can be toggled on restore
         BlockCls = nn.remat(Block) if self.remat == "block" else Block
@@ -609,6 +664,7 @@ class TransformerLM(nn.Module):
                 rope=rope,
                 num_kv_heads=self.num_kv_heads,
                 cache_dtype=self.cache_dtype,
+                slot_cursor=self.slot_cursor,
                 name=f"Block_{i}",
             )(x)
         x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
@@ -621,7 +677,8 @@ def generate(model, params, prompt, max_new_tokens: int,
              temperature: float = 0.0, seed: int = 0,
              eos_id: Optional[int] = None,
              top_k: Optional[int] = None,
-             top_p: Optional[float] = None) -> jnp.ndarray:
+             top_p: Optional[float] = None,
+             return_steps: bool = False) -> jnp.ndarray:
     """Autoregressive sampling from a trained :class:`TransformerLM`
     (VERDICT r3 next #8 — a framework that headlines LM training must be
     able to emit tokens).
@@ -647,9 +704,15 @@ def generate(model, params, prompt, max_new_tokens: int,
       top_p: nucleus sampling — restrict to the smallest set of tokens
         whose cumulative probability exceeds ``top_p``. Composes with
         ``top_k`` (k-filter first, then the nucleus).
+      return_steps: also return the number of decode steps actually run.
+        With ``eos_id`` set the decode loop is a ``lax.while_loop`` that
+        exits as soon as every row has finished — finished output is
+        still eos-padded to ``max_new_tokens``, but the padding costs no
+        decode steps.
 
     Returns:
-      ``[B, T_prompt + max_new_tokens]`` int32.
+      ``[B, T_prompt + max_new_tokens]`` int32 (and, with
+      ``return_steps``, the int decode-step count).
     """
     prompt = jnp.asarray(prompt, jnp.int32)
     if prompt.ndim != 2 or prompt.shape[1] < 1:
@@ -670,48 +733,70 @@ def generate(model, params, prompt, max_new_tokens: int,
     dm = model.clone(decode=True, parent=None)
     run = _generate_fn(dm, B, max_new_tokens, temperature, eos_id,
                        top_k, top_p)
-    new = run({"params": params["params"]}, prompt,
-              jax.random.PRNGKey(seed))
-    return jnp.concatenate([prompt, new], axis=1)
+    new, steps = run({"params": params["params"]}, prompt,
+                     jax.random.PRNGKey(seed))
+    out = jnp.concatenate([prompt, new], axis=1)
+    if return_steps:
+        return out, int(steps)
+    return out
+
+
+def sample_tokens(logits, rng, temperature=0.0, top_k=None, top_p=None):
+    """One sampling step: ``[B, vocab]`` logits → ``[B]`` int32 tokens.
+
+    Greedy argmax at temperature 0, else temperature softmax with
+    optional top-k / nucleus filtering. Module-level (factored out of
+    :func:`_generate_fn`) so the continuous-batching engine
+    (serving/engine.py) samples each slot with bit-identical math and
+    RNG usage to a solo :func:`generate` call — that identity is what
+    the slot-refill parity test asserts."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / temperature
+    if top_k is not None or top_p is not None:
+        # ONE descending sort serves both filters (this runs per
+        # decoded token): the k-filter folds into the sorted view as
+        # an -inf tail, which is exactly the sorted masked
+        # distribution the nucleus then operates on
+        sorted_desc = jnp.sort(logits, axis=-1)[..., ::-1]
+        if top_k is not None:
+            kth = sorted_desc[..., top_k - 1, None]
+            logits = jnp.where(logits < kth, -jnp.inf, logits)
+            sorted_desc = jnp.where(
+                jnp.arange(sorted_desc.shape[-1]) >= top_k,
+                -jnp.inf, sorted_desc,
+            )
+        if top_p is not None:
+            # nucleus: keep the smallest prefix of the sorted
+            # distribution whose mass exceeds top_p (the top token
+            # always survives: its cum - prob is 0 <= top_p)
+            probs = jax.nn.softmax(sorted_desc, axis=-1)
+            beyond = jnp.cumsum(probs, axis=-1) - probs > top_p
+            kept = jnp.where(beyond, jnp.inf, sorted_desc)
+            thresh = jnp.min(kept, axis=-1, keepdims=True)
+            logits = jnp.where(logits < thresh, -jnp.inf, logits)
+    return jax.random.categorical(rng, logits).astype(jnp.int32)
 
 
 @functools.lru_cache(maxsize=32)
 def _generate_fn(dm, B, max_new_tokens, temperature, eos_id,
                  top_k=None, top_p=None):
-    """Compiled prefill + decode-scan closure, cached per (decode module,
+    """Compiled prefill + decode-loop closure, cached per (decode module,
     batch, token count, sampling config) — flax modules hash by config,
     so repeated generate() calls (sampling loops, serving) hit the jit
-    cache instead of retracing the whole scan. Prompt length stays a
+    cache instead of retracing the whole loop. Prompt length stays a
     jit-traced dimension: each distinct T_prompt compiles its own prefill
-    once, as any jitted shape does."""
+    once, as any jitted shape does.
+
+    The decode loop is a fixed-length ``lax.scan`` without an eos, and an
+    early-exit ``lax.while_loop`` with one: once every row has finished,
+    the remaining steps would only emit pad eos tokens, so the loop stops
+    instead of burning them. ``run`` returns ``(tokens [B, max_new],
+    steps_taken)`` — the buffer is eos-initialized, so the early-exit
+    path keeps the exact eos-padded contract of the scan."""
 
     def sample(logits, rng):
-        if temperature == 0.0:
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        logits = logits / temperature
-        if top_k is not None or top_p is not None:
-            # ONE descending sort serves both filters (this runs per
-            # decoded token): the k-filter folds into the sorted view as
-            # an -inf tail, which is exactly the sorted masked
-            # distribution the nucleus then operates on
-            sorted_desc = jnp.sort(logits, axis=-1)[..., ::-1]
-            if top_k is not None:
-                kth = sorted_desc[..., top_k - 1, None]
-                logits = jnp.where(logits < kth, -jnp.inf, logits)
-                sorted_desc = jnp.where(
-                    jnp.arange(sorted_desc.shape[-1]) >= top_k,
-                    -jnp.inf, sorted_desc,
-                )
-            if top_p is not None:
-                # nucleus: keep the smallest prefix of the sorted
-                # distribution whose mass exceeds top_p (the top token
-                # always survives: its cum - prob is 0 <= top_p)
-                probs = jax.nn.softmax(sorted_desc, axis=-1)
-                beyond = jnp.cumsum(probs, axis=-1) - probs > top_p
-                kept = jnp.where(beyond, jnp.inf, sorted_desc)
-                thresh = jnp.min(kept, axis=-1, keepdims=True)
-                logits = jnp.where(logits < thresh, -jnp.inf, logits)
-        return jax.random.categorical(rng, logits).astype(jnp.int32)
+        return sample_tokens(logits, rng, temperature, top_k, top_p)
 
     @jax.jit
     def run(params_only, prompt, rng):
@@ -740,11 +825,36 @@ def _generate_fn(dm, B, max_new_tokens, temperature, eos_id,
             )
             return (vs["cache"], logits[:, -1], rng, done), tok
 
-        (_, _, _, _), toks = jax.lax.scan(
-            step, (cache, logits[:, -1], rng, done0), None,
-            length=max_new_tokens,
+        carry0 = (cache, logits[:, -1], rng, done0)
+        if eos_id is None:
+            (_, _, _, _), toks = jax.lax.scan(
+                step, carry0, None, length=max_new_tokens,
+            )
+            return toks.T, jnp.int32(max_new_tokens)
+
+        # eos set: early-exit once ALL rows are done (the rest of the
+        # fixed-length loop would only re-emit eos padding). The token
+        # buffer starts as eos, so unwritten tail columns equal what the
+        # scan would have produced.
+        toks0 = jnp.full((B, max_new_tokens), jnp.int32(eos_id))
+
+        def cond(c):
+            _, _, i = c
+            done = c[0][3]
+            return (i < max_new_tokens) & ~jnp.all(done)
+
+        def body(c):
+            carry, toks, i = c
+            carry, tok = step(carry, None)
+            toks = jax.lax.dynamic_update_index_in_dim(
+                toks, tok, i, axis=1
+            )
+            return (carry, toks, i + 1)
+
+        _, toks, steps = jax.lax.while_loop(
+            cond, body, (carry0, toks0, jnp.int32(0))
         )
-        return toks.T  # [B, max_new_tokens]
+        return toks, steps
 
     return run
 

@@ -1,0 +1,32 @@
+"""Continuous-batching LM serving.
+
+The static-batch :func:`~distkeras_tpu.models.transformer.generate` path
+measures the decode roofline; this package turns it into sustained
+request throughput: a fixed pool of KV-cache slots advanced by one jitted
+decode step per tick (:mod:`engine`), an admission queue with
+backpressure and deadlines (:mod:`scheduler`), and a TCP front-end that
+streams tokens per request over the framed-msgpack transport
+(:mod:`server`).
+"""
+
+from distkeras_tpu.serving.engine import ServingEngine  # noqa: F401
+from distkeras_tpu.serving.scheduler import (  # noqa: F401
+    FIFOScheduler,
+    QueueFullError,
+    Request,
+    TokenStream,
+)
+from distkeras_tpu.serving.server import (  # noqa: F401
+    LMServer,
+    ServingClient,
+)
+
+__all__ = [
+    "ServingEngine",
+    "FIFOScheduler",
+    "QueueFullError",
+    "Request",
+    "TokenStream",
+    "LMServer",
+    "ServingClient",
+]

@@ -1,0 +1,348 @@
+"""Continuous-batching decode engine (Orca/vLLM-style iteration-level
+scheduling) for :class:`~distkeras_tpu.models.transformer.TransformerLM`.
+
+The static :func:`~distkeras_tpu.models.transformer.generate` path runs a
+whole batch to ``max_new_tokens`` in lock step — a finished row burns
+decode steps emitting padding, and a new request waits for the slowest
+row. This engine removes both taxes while keeping every shape static
+(zero recompiles in steady state):
+
+- **Slot pool.** One preallocated per-layer KV cache of batch ``S``
+  (``slots``), the same GQA/int8 layout ``CausalSelfAttention`` already
+  uses, but with ``slot_cursor=True``: the cache cursor is a ``[S]``
+  vector, so each batch row is an independent sequence at its own depth.
+- **Per-slot prefill.** A joining prompt runs the ordinary B=1 decode
+  prefill (bit-identical to ``generate``'s), and its cache — K/V rows,
+  int8 scales, cursors — is scattered into the slot with
+  ``dynamic_update_slice``. No other slot is touched.
+- **One jitted tick.** Each tick samples one token per slot from the
+  pooled last-logits (per-slot sampling config and RNG chain, same math
+  as a solo ``generate``) and advances all ``S`` slots through one
+  decode step. Ticks are compiled once per distinct per-slot sampling
+  configuration tuple.
+- **Same-tick refill.** A slot whose request sampled its eos (or hit its
+  token budget) is freed when the tick's tokens are processed and
+  refilled from the scheduler queue in the same :meth:`step` call — the
+  next tick already decodes the new request.
+
+Observability rides :class:`~distkeras_tpu.utils.metrics.MetricsWriter`:
+per-tick records (slot occupancy, queue depth, per-token latency) and
+per-request TTFT, summarized by ``MetricsWriter.percentiles``.
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+import time
+from dataclasses import dataclass
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distkeras_tpu.models.transformer import sample_tokens
+from distkeras_tpu.serving.scheduler import FIFOScheduler, Request
+from distkeras_tpu.utils.metrics import MetricsWriter
+
+
+@functools.lru_cache(maxsize=64)
+def _prefill_fn(dm_one):
+    """Compiled per-slot prefill for a B=1 decode module: run the prompt
+    through the ordinary prefill (writing a fresh B=1 cache), then
+    scatter every cache leaf into row ``slot`` of the pooled cache.
+    Cached per decode-module config; each distinct prompt length traces
+    its own prefill, exactly like ``generate``."""
+
+    @jax.jit
+    def prefill(params_only, pooled, last_logits, prompt, slot):
+        cache1 = jax.tree.map(
+            lambda s: jnp.zeros(s.shape, s.dtype),
+            jax.eval_shape(
+                dm_one.init, jax.random.PRNGKey(0),
+                jnp.zeros((1, 1), jnp.int32),
+            )["cache"],
+        )
+        logits, vs = dm_one.apply(
+            {**params_only, "cache": cache1}, prompt, mutable=["cache"]
+        )
+
+        def merge(pool, one):
+            if one.ndim == 0:  # scalar cursor -> row of the [S] vector
+                return pool.at[slot].set(one.astype(pool.dtype))
+            # [1, ...] leaf -> rows [slot:slot+1, ...] of the pool
+            return jax.lax.dynamic_update_slice(
+                pool, one.astype(pool.dtype),
+                (slot,) + (0,) * (one.ndim - 1),
+            )
+
+        new_pool = jax.tree.map(merge, pooled, vs["cache"])
+        new_last = last_logits.at[slot].set(
+            logits[0, -1].astype(last_logits.dtype)
+        )
+        return new_pool, new_last
+
+    return prefill
+
+
+@functools.lru_cache(maxsize=256)
+def _tick_fn(dm_slot, cfgs):
+    """Compiled decode tick for one per-slot sampling-config tuple
+    ``cfgs = ((temperature, top_k, top_p), ...)``: sample one token per
+    slot (each from its own RNG chain, on a ``[1, vocab]`` logits slice —
+    the exact call shape of a solo B=1 ``generate``, so streams are
+    token-identical), then advance all slots one decode step."""
+
+    @jax.jit
+    def tick(params_only, cache, last_logits, rngs):
+        toks, new_rngs = [], []
+        for s, (temp, top_k, top_p) in enumerate(cfgs):
+            rng, sub = jax.random.split(rngs[s])
+            toks.append(
+                sample_tokens(last_logits[s][None], sub, temp,
+                              top_k, top_p)[0]
+            )
+            new_rngs.append(rng)
+        tok = jnp.stack(toks)  # [S]
+        logits, vs = dm_slot.apply(
+            {**params_only, "cache": cache}, tok[:, None],
+            mutable=["cache"],
+        )
+        return vs["cache"], logits[:, -1], tok, jnp.stack(new_rngs)
+
+    return tick
+
+
+_IDLE_CFG = (0.0, None, None)  # free slots sample greedily into the void
+
+
+@dataclass
+class _SlotState:
+    req: Request
+    remaining: int
+
+
+class ServingEngine:
+    """Continuous-batching serving over a fixed slot pool.
+
+    Args:
+      model: a TRAINING-mode :class:`TransformerLM` (``decode=False``) —
+        decode twins are cloned internally, so trained checkpoints work
+        as-is (same param tree).
+      params: trained variables (``{"params": ...}``).
+      slots: number of concurrent sequences ``S`` — the pooled KV cache
+        is ``[S, max_len, ...]`` per layer, allocated once.
+      max_len: serving context length (prompt + generated); defaults to
+        ``model.max_len``. Smaller values shrink the pooled cache.
+      scheduler: admission policy; defaults to a
+        :class:`FIFOScheduler` with its default backpressure knobs.
+      metrics: a :class:`MetricsWriter`; an in-memory one is created if
+        omitted (so :meth:`stats` always works).
+
+    Drive it with :meth:`step` (one admit→tick→complete→refill cycle,
+    e.g. from a test) or :meth:`serve_forever` (the TCP front-end's
+    loop thread). ``submit`` is thread-safe; stepping is single-threaded
+    by design.
+    """
+
+    def __init__(self, model, params, slots: int = 4,
+                 max_len: Optional[int] = None,
+                 scheduler: Optional[FIFOScheduler] = None,
+                 metrics: Optional[MetricsWriter] = None):
+        if slots < 1:
+            raise ValueError(f"slots must be >= 1; got {slots}")
+        self.model = (model if max_len is None
+                      else model.clone(max_len=max_len, parent=None))
+        self.slots = slots
+        self.scheduler = scheduler or FIFOScheduler()
+        self.metrics = metrics or MetricsWriter()
+        self._dm_slot = self.model.clone(
+            decode=True, slot_cursor=True, parent=None
+        )
+        self._dm_one = self.model.clone(decode=True, parent=None)
+        self._params_only = {"params": params["params"]}
+        self._cache = jax.tree.map(
+            lambda s: jnp.zeros(s.shape, s.dtype),
+            jax.eval_shape(
+                self._dm_slot.init, jax.random.PRNGKey(0),
+                jnp.zeros((slots, 1), jnp.int32),
+            )["cache"],
+        )
+        self._last_logits = jnp.zeros(
+            (slots, self.model.vocab_size), jnp.float32
+        )
+        self._rngs = jnp.zeros((slots, 2), jnp.uint32)
+        self._slots: List[Optional[_SlotState]] = [None] * slots
+        # counters (host-side observability)
+        self.ticks = 0
+        self.requests_completed = 0
+        self.tokens_generated = 0
+        self._occ_sum = 0
+
+    # -- submission ---------------------------------------------------------
+
+    def submit(self, prompt, max_new_tokens: int, temperature: float = 0.0,
+               seed: int = 0, eos_id: Optional[int] = None,
+               top_k: Optional[int] = None, top_p: Optional[float] = None,
+               deadline_s: Optional[float] = None) -> Request:
+        """Queue one request; returns it (consume ``request.stream``).
+        Raises :class:`QueueFullError` under backpressure and
+        ``ValueError`` for requests that can never fit the cache."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("prompt must contain at least one token")
+        if max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1; got {max_new_tokens}"
+            )
+        if prompt.size + max_new_tokens > self.model.max_len:
+            raise ValueError(
+                f"prompt ({prompt.size}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds max_len={self.model.max_len} "
+                f"(the per-slot KV-cache length)"
+            )
+        if top_k is not None:
+            if top_k < 1:
+                raise ValueError(f"top_k must be >= 1; got {top_k}")
+            top_k = min(top_k, self.model.vocab_size)
+        if top_p is not None and not 0.0 < top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1]; got {top_p}")
+        req = Request(
+            prompt=prompt, max_new_tokens=max_new_tokens,
+            temperature=temperature, seed=seed, eos_id=eos_id,
+            top_k=top_k, top_p=top_p, deadline_s=deadline_s,
+        )
+        return self.scheduler.submit(req)
+
+    # -- the engine loop ----------------------------------------------------
+
+    @property
+    def slot_requests(self) -> List[Optional[int]]:
+        """Request id per slot (None = free) — test/observability hook."""
+        return [st.req.rid if st else None for st in self._slots]
+
+    def step(self) -> bool:
+        """One scheduler iteration: admit into free slots, run one decode
+        tick over the pool, emit tokens, free finished slots, and refill
+        them from the queue (same call — the freed slot never idles a
+        tick). Returns False when there is nothing to do."""
+        self._admit()
+        occupied = any(st is not None for st in self._slots)
+        if occupied:
+            self._decode_tick()
+            # EOS'd / exhausted slots were freed while processing the
+            # tick's tokens: refill them NOW so the next tick decodes
+            # their replacement requests (same-tick refill)
+            self._admit()
+        return occupied or self.scheduler.depth() > 0
+
+    def serve_forever(self, stop: threading.Event,
+                      idle_sleep: float = 0.002):
+        """Step until ``stop`` is set, dozing briefly when idle."""
+        while not stop.is_set():
+            if not self.step():
+                stop.wait(idle_sleep)
+
+    def drain(self, timeout: float = 120.0):
+        """Step until queue and slots are empty (bench/test helper)."""
+        deadline = time.monotonic() + timeout
+        while self.step():
+            if time.monotonic() > deadline:
+                raise TimeoutError("engine did not drain in time")
+
+    # -- internals ----------------------------------------------------------
+
+    def _admit(self):
+        free = [i for i, st in enumerate(self._slots) if st is None]
+        if not free:
+            return
+        admitted, expired = self.scheduler.pop_admissible(len(free))
+        for req in expired:
+            req.done_t = time.monotonic()
+            req.stream._finish("expired")
+            self.metrics.summary(
+                "request", rid=req.rid, reason="expired", tokens=0,
+                queued_ms=round((req.done_t - req.submit_t) * 1e3, 3),
+            )
+        for req in admitted:
+            self._prefill_into(free.pop(0), req)
+
+    def _prefill_into(self, slot: int, req: Request):
+        prefill = _prefill_fn(self._dm_one)
+        prompt = jnp.asarray(req.prompt, jnp.int32)[None]
+        self._cache, self._last_logits = prefill(
+            self._params_only, self._cache, self._last_logits,
+            prompt, jnp.int32(slot),
+        )
+        self._rngs = self._rngs.at[slot].set(jax.random.PRNGKey(req.seed))
+        self._slots[slot] = _SlotState(req=req,
+                                       remaining=req.max_new_tokens)
+
+    def _decode_tick(self):
+        cfgs = tuple(
+            (st.req.temperature, st.req.top_k, st.req.top_p)
+            if st else _IDLE_CFG
+            for st in self._slots
+        )
+        tick = _tick_fn(self._dm_slot, cfgs)
+        t0 = time.perf_counter()
+        self._cache, self._last_logits, toks, self._rngs = tick(
+            self._params_only, self._cache, self._last_logits, self._rngs
+        )
+        toks_host = np.asarray(toks)  # forces completion of the tick
+        tick_ms = (time.perf_counter() - t0) * 1e3
+        self.ticks += 1
+        occupancy = sum(st is not None for st in self._slots)
+        self._occ_sum += occupancy
+        now = time.monotonic()
+        for s, st in enumerate(self._slots):
+            if st is None:
+                continue
+            req = st.req
+            tok = int(toks_host[s])
+            if req.first_token_t is None:
+                # TTFT lands in the per-request summary at completion
+                req.first_token_t = now
+            req.stream._put(tok)
+            req.n_emitted += 1
+            st.remaining -= 1
+            self.tokens_generated += 1
+            if req.eos_id is not None and tok == req.eos_id:
+                self._complete(s, "eos")
+            elif st.remaining == 0:
+                self._complete(s, "length")
+        self.metrics.log(
+            step=self.ticks, occupancy=occupancy,
+            queue_depth=self.scheduler.depth(),
+            token_ms=round(tick_ms, 3),
+        )
+
+    def _complete(self, slot: int, reason: str):
+        st = self._slots[slot]
+        req = st.req
+        req.done_t = time.monotonic()
+        req.stream._finish(reason)
+        self._slots[slot] = None
+        self.requests_completed += 1
+        self.metrics.summary(
+            "request", rid=req.rid, reason=reason, tokens=req.n_emitted,
+            ttft_ms=round((req.first_token_t - req.submit_t) * 1e3, 3),
+            total_ms=round((req.done_t - req.submit_t) * 1e3, 3),
+        )
+
+    # -- observability ------------------------------------------------------
+
+    def stats(self) -> dict:
+        """Counters + latency percentiles (TTFT and per-token, ms)."""
+        return {
+            "ticks": self.ticks,
+            "requests_completed": self.requests_completed,
+            "tokens_generated": self.tokens_generated,
+            "queue_depth": self.scheduler.depth(),
+            "mean_occupancy": (
+                round(self._occ_sum / self.ticks, 3) if self.ticks else 0.0
+            ),
+            "ttft_ms": self.metrics.percentiles("ttft_ms"),
+            "token_ms": self.metrics.percentiles("token_ms"),
+        }

@@ -1,0 +1,162 @@
+"""Request admission for the continuous-batching engine.
+
+The engine (:mod:`distkeras_tpu.serving.engine`) owns a fixed pool of
+decode slots; this module owns everything that happens *before* a request
+reaches one: a FIFO queue with a hard depth bound (backpressure — a
+caller that outruns the engine gets :class:`QueueFullError` immediately
+instead of growing an unbounded backlog), per-request deadlines (a
+request whose deadline passes while it is still queued is expired, never
+prefilled — the slot budget is spent on requests that can still meet
+their SLO), and a prefill/decode interleave cap (at most
+``max_prefills_per_tick`` admissions per engine tick, so a burst of
+arrivals cannot stall the decode latency of the requests already in
+flight behind a wall of prefill passes).
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue as _queue
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+
+class QueueFullError(RuntimeError):
+    """Admission queue is at ``max_queue_depth`` — the engine is not
+    keeping up with arrivals. Callers should shed load or retry later;
+    the TCP front-end maps this to an error reply."""
+
+
+class TokenStream:
+    """Per-request consumer handle: iterate tokens as the engine emits
+    them. The engine pushes from its loop thread; any consumer thread
+    iterates (or calls :meth:`tokens` to drain). After the stream ends,
+    ``finish_reason`` is one of ``"eos"`` (the request sampled its stop
+    token), ``"length"`` (``max_new_tokens`` reached), ``"expired"``
+    (deadline passed while queued), or ``"error"``."""
+
+    def __init__(self):
+        self._q: _queue.Queue = _queue.Queue()
+        self.finish_reason: Optional[str] = None
+
+    # engine side -----------------------------------------------------------
+
+    def _put(self, tok: int):
+        self._q.put(("tok", tok))
+
+    def _finish(self, reason: str):
+        self._q.put(("end", reason))
+
+    # consumer side ---------------------------------------------------------
+
+    def __iter__(self):
+        while True:
+            kind, val = self._q.get()
+            if kind == "end":
+                self.finish_reason = val
+                return
+            yield val
+
+    def tokens(self, timeout: Optional[float] = 60.0) -> List[int]:
+        """Drain the stream to completion (bounded wait per token so a
+        dead engine raises ``queue.Empty`` instead of hanging)."""
+        out: List[int] = []
+        while True:
+            kind, val = self._q.get(timeout=timeout)
+            if kind == "end":
+                self.finish_reason = val
+                return out
+            out.append(val)
+
+
+_rid_counter = itertools.count()
+
+
+@dataclass
+class Request:
+    """One generation request. ``prompt`` is a 1-D int32 token array;
+    sampling fields mirror :func:`~distkeras_tpu.models.transformer.generate`
+    exactly (same seed + params → the engine's per-slot stream is
+    token-identical to a solo ``generate`` call). ``deadline_s`` is a
+    relative first-token deadline: if the request is still queued when it
+    elapses, it is expired instead of admitted."""
+
+    prompt: np.ndarray
+    max_new_tokens: int
+    temperature: float = 0.0
+    seed: int = 0
+    eos_id: Optional[int] = None
+    top_k: Optional[int] = None
+    top_p: Optional[float] = None
+    deadline_s: Optional[float] = None
+    rid: int = field(default_factory=lambda: next(_rid_counter))
+    stream: TokenStream = field(default_factory=TokenStream)
+    # engine bookkeeping (monotonic timestamps)
+    submit_t: Optional[float] = None
+    first_token_t: Optional[float] = None
+    done_t: Optional[float] = None
+    n_emitted: int = 0
+
+
+class FIFOScheduler:
+    """FIFO admission with bounded depth, queued-deadline expiry, and a
+    per-tick prefill cap. Thread-safe: the TCP front-end submits from
+    handler threads while the engine pops from its loop thread."""
+
+    def __init__(self, max_queue_depth: int = 256,
+                 max_prefills_per_tick: int = 2):
+        if max_queue_depth < 1:
+            raise ValueError(
+                f"max_queue_depth must be >= 1; got {max_queue_depth}"
+            )
+        if max_prefills_per_tick < 1:
+            raise ValueError(
+                f"max_prefills_per_tick must be >= 1; "
+                f"got {max_prefills_per_tick}"
+            )
+        self.max_queue_depth = max_queue_depth
+        self.max_prefills_per_tick = max_prefills_per_tick
+        self._q: deque = deque()
+        self._lock = threading.Lock()
+
+    def submit(self, req: Request) -> Request:
+        """Enqueue or raise :class:`QueueFullError` (backpressure)."""
+        with self._lock:
+            if len(self._q) >= self.max_queue_depth:
+                raise QueueFullError(
+                    f"admission queue full "
+                    f"(max_queue_depth={self.max_queue_depth})"
+                )
+            req.submit_t = time.monotonic()
+            self._q.append(req)
+        return req
+
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._q)
+
+    def pop_admissible(
+        self, free_slots: int
+    ) -> Tuple[List[Request], List[Request]]:
+        """Pop up to ``min(free_slots, max_prefills_per_tick)`` requests
+        in FIFO order, dropping deadline-expired ones along the way.
+        Returns ``(admitted, expired)`` — the engine prefills the first
+        list and fails the second."""
+        admitted: List[Request] = []
+        expired: List[Request] = []
+        budget = min(free_slots, self.max_prefills_per_tick)
+        now = time.monotonic()
+        with self._lock:
+            while self._q and len(admitted) < budget:
+                req = self._q[0]
+                if (req.deadline_s is not None
+                        and now - req.submit_t > req.deadline_s):
+                    expired.append(self._q.popleft())
+                    continue
+                admitted.append(self._q.popleft())
+        return admitted, expired

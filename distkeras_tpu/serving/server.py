@@ -1,0 +1,274 @@
+"""TCP token-streaming front-end for the continuous-batching engine.
+
+Speaks the framed-msgpack transport this framework already uses
+(:mod:`distkeras_tpu.networking` ``send_msg``/``recv_msg``), with the
+same accept-loop shape as :class:`ParameterServerService`: one handler
+thread per connection, loopback bind by default, per-op error replies
+instead of dropped connections.
+
+Protocol (all frames are msgpack dicts):
+
+  client → server
+    {"op": "generate", "prompt": [ids], "max_new_tokens": n,
+     "temperature"?, "seed"?, "eos_id"?, "top_k"?, "top_p"?,
+     "deadline_s"?}
+    {"op": "stats"}
+
+  server → client
+    {"ok": 1, "id": rid}                      # generate accepted
+    {"ok": 0, "error": msg}                   # rejected (e.g. backpressure)
+    {"id": rid, "t": tok}                     # one streamed token
+    {"id": rid, "done": 1, "reason": r, "n": k}   # stream end
+    {"ok": 1, "stats": {...}}                 # stats reply
+
+Tokens stream as the engine emits them — a connection may hold many
+in-flight requests, so frames are tagged with the request id and the
+client demultiplexes. Token pushes run in per-request pump threads fed by
+the request's :class:`TokenStream`, so a slow client never stalls the
+engine loop; a per-connection lock keeps frames whole.
+"""
+
+from __future__ import annotations
+
+import queue as _queue
+import socket
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from distkeras_tpu.networking import connect, recv_msg, send_msg
+from distkeras_tpu.serving.engine import ServingEngine
+from distkeras_tpu.serving.scheduler import QueueFullError
+
+# serving frames are small (one token or one prompt); cap accordingly
+MAX_SERVE_FRAME_BYTES = 1 << 24  # 16 MiB
+
+
+class LMServer:
+    """Serve a :class:`ServingEngine` over TCP. ``start()`` spins the
+    accept loop and the engine's own loop thread; ``stop()`` winds both
+    down. Binds loopback unless an explicit host is given."""
+
+    def __init__(self, engine: ServingEngine, host: str = "127.0.0.1",
+                 port: int = 0,
+                 max_frame_bytes: int = MAX_SERVE_FRAME_BYTES):
+        self.engine = engine
+        self.max_frame_bytes = max_frame_bytes
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self.port = self._sock.getsockname()[1]
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+
+    def start(self) -> "LMServer":
+        self._sock.listen(64)
+        for target in (self._accept_loop, self._engine_loop):
+            t = threading.Thread(target=target, daemon=True)
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def stop(self, timeout: float = 10.0):
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        for t in self._threads:
+            t.join(timeout)
+
+    # -- loops --------------------------------------------------------------
+
+    def _engine_loop(self):
+        self.engine.serve_forever(self._stop)
+
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            t = threading.Thread(
+                target=self._handle, args=(conn,), daemon=True
+            )
+            t.start()
+            self._threads = [x for x in self._threads if x.is_alive()]
+            self._threads.append(t)
+
+    # -- per-connection handler ---------------------------------------------
+
+    @staticmethod
+    def _send(conn: socket.socket, lock: threading.Lock, msg: dict):
+        with lock:
+            send_msg(conn, msg)
+
+    def _pump(self, conn, lock, req):
+        """Forward one request's token stream to the client."""
+        n = 0
+        try:
+            for tok in req.stream:
+                self._send(conn, lock, {"id": req.rid, "t": int(tok)})
+                n += 1
+            self._send(conn, lock, {
+                "id": req.rid, "done": 1,
+                "reason": req.stream.finish_reason, "n": n,
+            })
+        except (ConnectionError, OSError):
+            # client went away mid-stream: drain silently (the engine
+            # finishes the request; its tokens are simply dropped)
+            for _ in req.stream:
+                pass
+
+    def _handle(self, conn: socket.socket):
+        lock = threading.Lock()
+        pumps: List[threading.Thread] = []
+        try:
+            while not self._stop.is_set():
+                try:
+                    msg = recv_msg(conn, max_bytes=self.max_frame_bytes)
+                except Exception:  # malformed/oversized: drop this client
+                    return
+                if msg is None or not isinstance(msg, dict):
+                    return
+                op = msg.get("op")
+                try:
+                    if op == "generate":
+                        req = self.engine.submit(
+                            prompt=[int(t) for t in msg["prompt"]],
+                            max_new_tokens=int(msg["max_new_tokens"]),
+                            temperature=float(msg.get("temperature", 0.0)),
+                            seed=int(msg.get("seed", 0)),
+                            eos_id=(None if msg.get("eos_id") is None
+                                    else int(msg["eos_id"])),
+                            top_k=(None if msg.get("top_k") is None
+                                   else int(msg["top_k"])),
+                            top_p=(None if msg.get("top_p") is None
+                                   else float(msg["top_p"])),
+                            deadline_s=(
+                                None if msg.get("deadline_s") is None
+                                else float(msg["deadline_s"])),
+                        )
+                        # ack BEFORE the pump starts so the acceptance
+                        # frame always precedes the first token frame
+                        self._send(conn, lock, {"ok": 1, "id": req.rid})
+                        t = threading.Thread(
+                            target=self._pump, args=(conn, lock, req),
+                            daemon=True,
+                        )
+                        t.start()
+                        pumps.append(t)
+                    elif op == "stats":
+                        self._send(conn, lock,
+                                   {"ok": 1, "stats": self.engine.stats()})
+                    else:
+                        self._send(conn, lock,
+                                   {"ok": 0, "error": f"unknown op {op!r}"})
+                except (ConnectionError, OSError):
+                    raise
+                except QueueFullError as e:
+                    self._send(conn, lock, {"ok": 0, "error": str(e)})
+                except Exception as e:
+                    self._send(conn, lock, {
+                        "ok": 0, "error": f"{type(e).__name__}: {e}"
+                    })
+        except (ConnectionError, OSError):
+            return
+        finally:
+            for t in pumps:
+                t.join(timeout=5.0)
+            conn.close()
+
+
+class ServingClient:
+    """Client for :class:`LMServer`: submit prompts, iterate streamed
+    tokens. A reader thread demultiplexes tagged frames into per-request
+    queues, so many requests can be in flight on one connection."""
+
+    def __init__(self, host: str, port: int, timeout: float = 60.0):
+        self._sock = connect(host, port)
+        self._sock.settimeout(timeout)
+        self._send_lock = threading.Lock()
+        self._acks: _queue.Queue = _queue.Queue()
+        self._streams: Dict[int, _queue.Queue] = {}
+        self._streams_lock = threading.Lock()
+        self._closed = False
+        self._reader = threading.Thread(target=self._read_loop, daemon=True)
+        self._reader.start()
+
+    def _stream_q(self, rid: int) -> _queue.Queue:
+        with self._streams_lock:
+            if rid not in self._streams:
+                self._streams[rid] = _queue.Queue()
+            return self._streams[rid]
+
+    def _read_loop(self):
+        try:
+            while True:
+                msg = recv_msg(self._sock)
+                if msg is None:
+                    break
+                if "t" in msg:
+                    self._stream_q(int(msg["id"])).put(("tok", int(msg["t"])))
+                elif "done" in msg:
+                    self._stream_q(int(msg["id"])).put(
+                        ("end", str(msg.get("reason")))
+                    )
+                else:
+                    self._acks.put(msg)
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            self._closed = True
+            # unblock anyone waiting on a stream or an ack
+            with self._streams_lock:
+                for q in self._streams.values():
+                    q.put(("end", "connection closed"))
+            self._acks.put({"ok": 0, "error": "connection closed"})
+
+    def _call(self, msg: dict, timeout: float = 60.0) -> dict:
+        with self._send_lock:
+            send_msg(self._sock, msg)
+        reply = self._acks.get(timeout=timeout)
+        if not reply.get("ok"):
+            raise RuntimeError(reply.get("error", "request rejected"))
+        return reply
+
+    def generate(self, prompt, max_new_tokens: int, **kw) -> int:
+        """Submit one request; returns its id (stream via
+        :meth:`stream` / :meth:`result`). Raises RuntimeError on
+        rejection (e.g. queue backpressure)."""
+        msg = {"op": "generate",
+               "prompt": [int(t) for t in prompt],
+               "max_new_tokens": int(max_new_tokens)}
+        msg.update({k: v for k, v in kw.items() if v is not None})
+        return int(self._call(msg)["id"])
+
+    def stream(self, rid: int):
+        """Yield tokens for a request as they arrive."""
+        q = self._stream_q(rid)
+        while True:
+            kind, val = q.get()
+            if kind == "end":
+                return
+            yield val
+
+    def result(self, rid: int,
+               timeout: float = 60.0) -> Tuple[List[int], Optional[str]]:
+        """Block until a request finishes: (tokens, finish_reason)."""
+        q = self._stream_q(rid)
+        out: List[int] = []
+        while True:
+            kind, val = q.get(timeout=timeout)
+            if kind == "end":
+                return out, val
+            out.append(val)
+
+    def stats(self) -> dict:
+        return dict(self._call({"op": "stats"})["stats"])
+
+    def close(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass

@@ -54,6 +54,29 @@ class MetricsWriter:
     def records(self) -> List[dict]:
         return list(self._records)
 
+    def percentiles(
+        self, key: str, ps=(50, 90, 99)
+    ) -> Optional[Dict[str, float]]:
+        """p50/p90/p99 (linear interpolation, numpy convention) over
+        every logged record carrying ``key`` — the serving engine and
+        serve_bench both report their TTFT / per-token latency
+        distributions through this. None when nothing logged ``key``."""
+        with self._lock:
+            vals = sorted(
+                float(r[key]) for r in self._records if key in r
+            )
+        if not vals:
+            return None
+        out: Dict[str, float] = {}
+        for p in ps:
+            rank = (len(vals) - 1) * p / 100.0
+            lo = int(rank)
+            hi = min(lo + 1, len(vals) - 1)
+            out[f"p{p}"] = round(
+                vals[lo] + (vals[hi] - vals[lo]) * (rank - lo), 6
+            )
+        return out
+
     def throughput(self) -> Optional[float]:
         """Overall samples/sec across logged records (None without samples)."""
         with_samples = [r for r in self._records if "samples" in r]

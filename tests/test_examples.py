@@ -160,6 +160,20 @@ def test_lm_training_example_pp_smoke(monkeypatch, capsys):
     assert "tokens/sec" in out and "pp" in out
 
 
+def test_lm_serving_example_smoke(monkeypatch, capsys):
+    """Serving example end-to-end: server + client over localhost TCP,
+    streamed tokens parity-checked against solo generate()."""
+    sys.path.insert(0, "examples")
+    run_example(
+        monkeypatch, "lm_serving",
+        ["lm_serving.py", "--prompts", "3", "--max-new", "8",
+         "--slots", "2", "--prompt-len", "6", "--vocab", "64"],
+    )
+    out = capsys.readouterr().out
+    assert out.count("parity OK") == 3
+    assert "served 3 requests" in out
+
+
 def test_lm_training_text_mode_smoke(monkeypatch, capsys, tmp_path):
     """--text end-to-end on a tiny corpus: byte-tokenize, train with the
     cosine schedule, report held-out perplexity, print a decoded

@@ -19,6 +19,20 @@ def test_metrics_writer_jsonl(tmp_path):
     assert w.throughput() is None or w.throughput() > 0
 
 
+def test_percentiles():
+    w = MetricsWriter()
+    assert w.percentiles("ttft_ms") is None
+    for v in range(1, 101):  # 1..100
+        w.log(step=v, ttft_ms=float(v))
+    p = w.percentiles("ttft_ms")
+    assert p["p50"] == 50.5 and p["p90"] == 90.1 and p["p99"] == 99.01
+    # summary records (the engine's per-request lines) count too
+    w2 = MetricsWriter()
+    for v in (10.0, 20.0, 30.0):
+        w2.summary("request", ttft_ms=v)
+    assert w2.percentiles("ttft_ms", ps=(50,)) == {"p50": 20.0}
+
+
 def test_staleness_histogram():
     assert staleness_histogram([0, 0, 1, 3, 1, 0]) == {0: 3, 1: 2, 3: 1}
     assert staleness_histogram([]) == {}
