@@ -282,6 +282,10 @@ def config7():
         "static_baseline": out["static_tokens_per_sec"],
         "speedup": out["speedup"],
         "ttft_ms": out["ttft_ms"],
+        # full latency distributions (telemetry-registry histograms):
+        # the perf trajectory keeps tails, not just throughput
+        "ttft_hist": out["ttft_hist"],
+        "token_ms_hist": out["token_ms_hist"],
         "model": out["config"],
         "data": "synthetic-poisson-trace",
     }))

@@ -12,7 +12,10 @@ with mixed output lengths to two systems serving the same model:
 Both replay the identical arrival trace; sustained tokens/sec is total
 generated tokens over the makespan (first arrival → last completion), so
 queueing time counts against each system. TTFT p50/p99 come from the
-engine's MetricsWriter percentiles.
+engine's MetricsWriter percentiles; full TTFT and per-token latency
+*distributions* (fixed-bucket histograms) come from a run-isolated
+telemetry MetricRegistry and land in the emitted JSON, so the BENCH
+trajectory captures tails, not just means.
 
 Sizing note: every engine tick pays a host round trip (~1 ms on CPU)
 that the static path's fully-jitted decode scan never does; the default
@@ -57,6 +60,7 @@ def _trace(n_requests, prompt_len, vocab, mean_interarrival_s, seed=0):
 
 def bench(V=1024, D=256, H=4, L=4, slots=8, n_requests=48, prompt_len=16,
           mean_interarrival_s=0.002, dtype="float32", metrics_path=None):
+    from distkeras_tpu import telemetry
     from distkeras_tpu.models import get_model
     from distkeras_tpu.models.transformer import generate
     from distkeras_tpu.serving import ServingEngine
@@ -81,7 +85,11 @@ def bench(V=1024, D=256, H=4, L=4, slots=8, n_requests=48, prompt_len=16,
 
     # -- continuous-batching engine -----------------------------------------
     metrics = MetricsWriter(metrics_path)
-    engine = ServingEngine(model, params, slots=slots, metrics=metrics)
+    # run-isolated registry: the emitted histograms cover exactly this
+    # measured run (the warmup engine above used the global default)
+    registry = telemetry.MetricRegistry()
+    engine = ServingEngine(model, params, slots=slots, metrics=metrics,
+                           registry=registry)
     stop = threading.Event()
     loop = threading.Thread(target=engine.serve_forever, args=(stop,),
                             daemon=True)
@@ -113,11 +121,15 @@ def bench(V=1024, D=256, H=4, L=4, slots=8, n_requests=48, prompt_len=16,
         tokens_static += int(np.asarray(out).shape[1]) - prompt_len
     dt_static = time.perf_counter() - t0
 
+    ttft_hist = registry.histogram("serving_ttft_ms").value
+    token_hist = registry.histogram("serving_token_ms").value
     result = {
         "serve_tokens_per_sec": round(tokens_engine / dt_engine, 1),
         "static_tokens_per_sec": round(tokens_static / dt_static, 1),
         "speedup": round(dt_static / dt_engine, 2),
         "ttft_ms": stats["ttft_ms"],
+        "ttft_hist": ttft_hist,
+        "token_ms_hist": token_hist,
         "mean_occupancy": stats["mean_occupancy"],
         "config": f"d{D}/h{H}/L{L}/v{V}-slots{slots}-req{n_requests}"
                   f"-prompt{prompt_len}-poisson{mean_interarrival_s}"

@@ -31,16 +31,46 @@ import os
 import socket
 import struct
 import threading
+import time
 from typing import Any, Optional
 
 import jax
 import numpy as np
 from flax import serialization as flax_serialization
 
+from distkeras_tpu import telemetry
+
 
 def _to_host(tree):
     """Device/jax arrays → numpy (msgpack can't serialize jax Arrays)."""
     return jax.tree.map(np.asarray, tree)
+
+
+# Transport-level telemetry: every framed send/recv in the process
+# (PS exchanges AND serving token frames) counts here, so the scrape
+# endpoint can answer "how many bytes is this host moving over DCN".
+# Bound children are resolved once — the hot path is two locked adds.
+_NET_FRAMES = telemetry.get_registry().counter(
+    "net_frames_total", "framed-msgpack frames moved",
+    labelnames=("direction",),
+)
+_NET_BYTES = telemetry.get_registry().counter(
+    "net_bytes_total", "framed-msgpack payload bytes moved",
+    labelnames=("direction",),
+)
+_SENT_FRAMES = _NET_FRAMES.labels(direction="sent")
+_SENT_BYTES = _NET_BYTES.labels(direction="sent")
+_RECV_FRAMES = _NET_FRAMES.labels(direction="received")
+_RECV_BYTES = _NET_BYTES.labels(direction="received")
+
+
+def _tree_nbytes(tree) -> int:
+    """Host-side payload size of a pytree (numpy leaves after msgpack
+    restore / before serialize); scalars count as zero."""
+    total = 0
+    for leaf in jax.tree.leaves(tree):
+        total += int(getattr(leaf, "nbytes", 0) or 0)
+    return total
 
 # ---------------------------------------------------------------------------
 # Native data plane (ctypes; pure-Python fallback)
@@ -117,6 +147,8 @@ def send_frame(sock: socket.socket, payload: bytes):
             raise ConnectionError("dk_send_frame failed")
     else:
         sock.sendall(struct.pack(">Q", len(payload)) + payload)
+    _SENT_FRAMES.inc()
+    _SENT_BYTES.inc(len(payload))
 
 
 def recv_frame(
@@ -136,6 +168,8 @@ def recv_frame(
         buf = ctypes.create_string_buffer(size)
         if lib.dk_recv_exact(sock.fileno(), buf, size) != 0:
             return None
+        _RECV_FRAMES.inc()
+        _RECV_BYTES.inc(size)
         return buf.raw
     header = _recv_exact_py(sock, 8)
     if header is None:
@@ -145,7 +179,11 @@ def recv_frame(
         raise ConnectionError(
             f"frame of {size} bytes exceeds max_bytes={max_bytes}"
         )
-    return _recv_exact_py(sock, size)
+    data = _recv_exact_py(sock, size)
+    if data is not None:
+        _RECV_FRAMES.inc()
+        _RECV_BYTES.inc(size)
+    return data
 
 
 def _recv_exact_py(sock: socket.socket, n: int) -> Optional[bytes]:
@@ -209,14 +247,41 @@ class ParameterServerService:
     set), caps frame sizes, replies ``{"error": ...}`` on per-op failures
     instead of dropping the connection, and prunes finished handler
     threads.
+
+    Telemetry: every op records latency into
+    ``ps_op_latency_ms{op=...}`` (plus op counts and payload bytes) in
+    the service's :class:`~distkeras_tpu.telemetry.MetricRegistry`, and
+    a ``"trace"`` id carried on the message (the remote proxy attaches
+    one per call) yields a ``ps.<op>`` span in the tracer. Two read-only
+    ops expose both over the wire: ``{"op": "stats"}`` →
+    ``{"num_updates", "metrics": registry.collect()}`` and
+    ``{"op": "trace_dump", "trace"?, "limit"?}`` → ``{"spans": [...]}``.
     """
 
     def __init__(self, ps, host: str = "127.0.0.1", port: int = 0,
                  secret: Optional[str] = None,
-                 max_frame_bytes: int = MAX_FRAME_BYTES):
+                 max_frame_bytes: int = MAX_FRAME_BYTES,
+                 registry: Optional[telemetry.MetricRegistry] = None,
+                 tracer: Optional[telemetry.Tracer] = None):
         self.ps = ps
         self.secret = secret
         self.max_frame_bytes = max_frame_bytes
+        self.registry = registry or telemetry.get_registry()
+        self.tracer = tracer or telemetry.get_tracer()
+        self._m_ops = self.registry.counter(
+            "ps_ops_total", "parameter-server service ops handled",
+            labelnames=("op",),
+        )
+        self._m_op_ms = self.registry.histogram(
+            "ps_op_latency_ms",
+            "service-side op latency: dispatch through reply (ms)",
+            labelnames=("op",),
+        )
+        self._m_op_bytes = self.registry.counter(
+            "ps_op_bytes_total",
+            "pytree payload bytes moved per op (host-side nbytes)",
+            labelnames=("op",),
+        )
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -272,12 +337,20 @@ class ParameterServerService:
                         continue
                     send_msg(conn, {"error": "auth required"})
                     return
+                t0 = time.monotonic()
                 try:
                     self._dispatch(conn, op, msg)
                 except (ConnectionError, OSError):
                     raise
                 except Exception as e:  # op failure: reply, keep serving
                     send_msg(conn, {"error": f"{type(e).__name__}: {e}"})
+                finally:
+                    ms = (time.monotonic() - t0) * 1e3
+                    op_name = str(op)
+                    self._m_ops.labels(op=op_name).inc()
+                    self._m_op_ms.labels(op=op_name).observe(ms)
+                    self.tracer.record(msg.get("trace"), f"ps.{op_name}",
+                                       t0, ms)
         except (ConnectionError, OSError):
             return
         finally:
@@ -288,17 +361,29 @@ class ParameterServerService:
         # boundary, so every outgoing tree crosses through pull_host /
         # _to_host before serialization
         if op == "pull":
-            send_msg(conn, {"value": self.ps.pull_host()})
+            value = self.ps.pull_host()
+            self._m_op_bytes.labels(op="pull").inc(_tree_nbytes(value))
+            send_msg(conn, {"value": value})
         elif op == "pull_with_clock":
             value, clock = self.ps.pull_with_clock()
-            send_msg(conn, {"value": _to_host(value), "clock": clock})
+            value = _to_host(value)
+            self._m_op_bytes.labels(op="pull_with_clock").inc(
+                _tree_nbytes(value)
+            )
+            send_msg(conn, {"value": value, "clock": clock})
         elif op == "commit":
+            self._m_op_bytes.labels(op="commit").inc(
+                _tree_nbytes(msg["delta"])
+            )
             self.ps.commit(
                 msg["delta"], worker=int(msg.get("worker", 0)),
                 worker_clock=int(msg.get("clock", 0)),
             )
             send_msg(conn, {"ok": 1})
         elif op == "commit_and_wait":
+            self._m_op_bytes.labels(op="commit_and_wait").inc(
+                _tree_nbytes(msg["params"])
+            )
             center = self.ps.commit_and_wait(
                 msg["params"], worker=int(msg.get("worker", 0))
             )
@@ -319,6 +404,18 @@ class ParameterServerService:
             send_msg(conn, {"ok": 1})
         elif op == "num_updates":
             send_msg(conn, {"value": self.ps.num_updates})
+        elif op == "stats":
+            send_msg(conn, {
+                "num_updates": self.ps.num_updates,
+                "metrics": self.registry.collect(),
+            })
+        elif op == "trace_dump":
+            send_msg(conn, {"spans": self.tracer.dump(
+                trace=(None if msg.get("trace") is None
+                       else int(msg["trace"])),
+                limit=(None if msg.get("limit") is None
+                       else int(msg["limit"])),
+            )})
         else:
             send_msg(conn, {"error": f"unknown op {op!r}"})
 
@@ -383,9 +480,17 @@ class RemoteParameterServer:
         return self._local.sock
 
     def _call(self, msg: dict) -> dict:
+        # allocate a trace id per op and send it along: the service
+        # records the matching ps.<op> span server-side, so one id links
+        # both halves of the round trip
+        tracer = telemetry.get_tracer()
+        tid = msg.setdefault("trace", tracer.new_trace_id())
         sock = self._sock()
+        t0 = time.monotonic()
         send_msg(sock, msg)
         reply = recv_msg(sock)
+        tracer.record(tid, f"ps.rpc.{msg.get('op')}", t0,
+                      (time.monotonic() - t0) * 1e3)
         if reply is None:
             raise ConnectionError("parameter server closed the connection")
         if "error" in reply:
@@ -431,6 +536,22 @@ class RemoteParameterServer:
     @property
     def num_updates(self) -> int:
         return int(self._call({"op": "num_updates"})["value"])
+
+    def stats(self) -> dict:
+        """Service-side update count + metric-registry snapshot."""
+        return dict(self._call({"op": "stats"}))
+
+    def trace_dump(self, trace: Optional[int] = None,
+                   limit: Optional[int] = None) -> list:
+        """Service-side span records (optionally one trace id)."""
+        # "trace" doubles as this op's filter, so pin it explicitly —
+        # otherwise _call's auto-attached span id would filter the dump
+        # down to (almost) nothing
+        msg: dict = {"op": "trace_dump",
+                     "trace": None if trace is None else int(trace)}
+        if limit is not None:
+            msg["limit"] = int(limit)
+        return list(self._call(msg)["spans"])
 
     def close(self):
         if hasattr(self._local, "sock"):

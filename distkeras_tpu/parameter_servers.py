@@ -44,11 +44,27 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from distkeras_tpu import telemetry
 from distkeras_tpu.ops import rules
 
 
 def _to_host(tree):
     return jax.tree.map(np.asarray, tree)
+
+
+# Registry series shared by every PS instance in the process (the
+# per-instance view stays on `num_updates` / `staleness_log`): commit
+# counts by server class, and the DynSGD staleness distribution — the
+# live equivalent of utils.metrics.staleness_histogram's end-of-run
+# summary, scrapeable mid-training.
+_PS_COMMITS = telemetry.get_registry().counter(
+    "ps_commits_total", "center commits applied", labelnames=("kind",),
+)
+_PS_STALENESS = telemetry.get_registry().histogram(
+    "ps_commit_staleness",
+    "commit staleness in server-clock ticks (DynSGD)",
+    buckets=telemetry.STALENESS_BUCKETS,
+)
 
 
 # Donated commit kernels (module-level so every PS instance shares one
@@ -117,6 +133,7 @@ class ParameterServer:
         caller converts and saves it AFTER releasing the lock so checkpoint
         I/O never stalls concurrent commits."""
         self.num_updates += 1
+        _PS_COMMITS.labels(kind=type(self).__name__).inc()
         if (
             self.checkpointer is not None
             and self.num_updates % self.checkpointer.every_steps == 0
@@ -231,6 +248,7 @@ class DynSGDParameterServer(ParameterServer):
         with self.lock:
             staleness = max(0, self.clock - worker_clock)
             self.staleness_log.append(staleness)
+            _PS_STALENESS.observe(staleness)
             self.center = _commit_scaled(
                 self.center, delta, np.float32(1.0 / (staleness + 1.0))
             )

@@ -25,9 +25,17 @@ row. This engine removes both taxes while keeping every shape static
   refilled from the scheduler queue in the same :meth:`step` call — the
   next tick already decodes the new request.
 
-Observability rides :class:`~distkeras_tpu.utils.metrics.MetricsWriter`:
-per-tick records (slot occupancy, queue depth, per-token latency) and
-per-request TTFT, summarized by ``MetricsWriter.percentiles``.
+Observability is the :mod:`distkeras_tpu.telemetry` layer: every request
+leaves a span chain (``queued → prefill → decode → finish``, with slot
+id and token counts) in the tracer, and the engine publishes live
+counters/gauges/histograms (tick count, tokens, occupancy, queue depth,
+TTFT, per-token latency, prefill fraction) into a
+:class:`~distkeras_tpu.telemetry.MetricRegistry` — scrapeable over the
+msgpack ``stats``/``trace_dump`` ops and the HTTP endpoint. The
+per-tick/per-request JSONL records still ride
+:class:`~distkeras_tpu.utils.metrics.MetricsWriter` for offline
+analysis. All instrumentation is host-side bookkeeping around the jitted
+calls — token streams stay bit-identical to solo ``generate()``.
 """
 
 from __future__ import annotations
@@ -42,6 +50,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from distkeras_tpu import telemetry
 from distkeras_tpu.models.transformer import sample_tokens
 from distkeras_tpu.serving.scheduler import FIFOScheduler, Request
 from distkeras_tpu.utils.metrics import MetricsWriter
@@ -139,6 +148,13 @@ class ServingEngine:
         :class:`FIFOScheduler` with its default backpressure knobs.
       metrics: a :class:`MetricsWriter`; an in-memory one is created if
         omitted (so :meth:`stats` always works).
+      registry: the :class:`~distkeras_tpu.telemetry.MetricRegistry` the
+        engine publishes into; defaults to the process-global one. Pass
+        a fresh instance to isolate a run (benchmarks, tests).
+      tracer: the :class:`~distkeras_tpu.telemetry.Tracer` recording the
+        per-request span chain; defaults to the process-global one. The
+        scheduler (given or created) is adopted into the same pair so
+        trace ids and queue metrics stay coherent.
 
     Drive it with :meth:`step` (one admit→tick→complete→refill cycle,
     e.g. from a test) or :meth:`serve_forever` (the TCP front-end's
@@ -149,13 +165,25 @@ class ServingEngine:
     def __init__(self, model, params, slots: int = 4,
                  max_len: Optional[int] = None,
                  scheduler: Optional[FIFOScheduler] = None,
-                 metrics: Optional[MetricsWriter] = None):
+                 metrics: Optional[MetricsWriter] = None,
+                 registry: Optional[telemetry.MetricRegistry] = None,
+                 tracer: Optional[telemetry.Tracer] = None):
         if slots < 1:
             raise ValueError(f"slots must be >= 1; got {slots}")
         self.model = (model if max_len is None
                       else model.clone(max_len=max_len, parent=None))
         self.slots = slots
-        self.scheduler = scheduler or FIFOScheduler()
+        self.registry = registry or telemetry.get_registry()
+        self.tracer = tracer or telemetry.get_tracer()
+        self.scheduler = scheduler or FIFOScheduler(
+            tracer=self.tracer, registry=self.registry
+        )
+        # adopt an externally-built scheduler into this engine's
+        # telemetry so one trace id space covers queue + slots
+        self.scheduler.tracer = self.tracer
+        self.scheduler.registry = self.registry
+        self.scheduler._wire_metrics()
+        self._wire_metrics()
         self.metrics = metrics or MetricsWriter()
         self._dm_slot = self.model.clone(
             decode=True, slot_cursor=True, parent=None
@@ -174,11 +202,40 @@ class ServingEngine:
         )
         self._rngs = jnp.zeros((slots, 2), jnp.uint32)
         self._slots: List[Optional[_SlotState]] = [None] * slots
-        # counters (host-side observability)
+        # counters (host-side observability; per-engine, unlike the
+        # process-cumulative registry series)
         self.ticks = 0
         self.requests_completed = 0
         self.tokens_generated = 0
         self._occ_sum = 0
+
+    def _wire_metrics(self):
+        """Register this engine's metric handles (get-or-create: many
+        engines on one registry share the series)."""
+        reg = self.registry
+        self._m_ticks = reg.counter(
+            "serving_ticks_total", "decode ticks executed")
+        self._m_tokens = reg.counter(
+            "serving_tokens_total", "tokens sampled and emitted")
+        self._m_requests = reg.counter(
+            "serving_requests_total",
+            "requests finished, by finish reason", labelnames=("reason",))
+        self._m_occupancy = reg.gauge(
+            "serving_slot_occupancy", "decode slots holding a request")
+        self._m_tick_ms = reg.histogram(
+            "serving_token_ms",
+            "per-token latency: one decode tick, host-observed (ms)")
+        self._m_ttft_ms = reg.histogram(
+            "serving_ttft_ms", "submit to first token (ms)")
+        self._m_prefill_ms = reg.histogram(
+            "serving_prefill_ms", "per-slot prefill dispatch (ms)")
+        self._m_prefill_frac = reg.histogram(
+            "serving_prefill_fraction",
+            "per step(): prefills / (prefills + decode tick)",
+            buckets=telemetry.FRACTION_BUCKETS)
+        self._m_decode_tps = reg.gauge(
+            "serving_decode_tokens_per_sec",
+            "tokens emitted by the latest tick over its wall time")
 
     # -- submission ---------------------------------------------------------
 
@@ -227,14 +284,17 @@ class ServingEngine:
         tick over the pool, emit tokens, free finished slots, and refill
         them from the queue (same call — the freed slot never idles a
         tick). Returns False when there is nothing to do."""
-        self._admit()
+        n_prefills = self._admit()
         occupied = any(st is not None for st in self._slots)
         if occupied:
             self._decode_tick()
             # EOS'd / exhausted slots were freed while processing the
             # tick's tokens: refill them NOW so the next tick decodes
             # their replacement requests (same-tick refill)
-            self._admit()
+            n_prefills += self._admit()
+            # share of this step's device dispatches that were prefill
+            # passes (decode-latency pressure from arrival bursts)
+            self._m_prefill_frac.observe(n_prefills / (n_prefills + 1))
         return occupied or self.scheduler.depth() > 0
 
     def serve_forever(self, stop: threading.Event,
@@ -253,24 +313,35 @@ class ServingEngine:
 
     # -- internals ----------------------------------------------------------
 
-    def _admit(self):
+    def _admit(self) -> int:
         free = [i for i, st in enumerate(self._slots) if st is None]
         if not free:
-            return
+            return 0
         admitted, expired = self.scheduler.pop_admissible(len(free))
         for req in expired:
             req.done_t = time.monotonic()
+            queued_ms = (req.done_t - req.submit_t) * 1e3
+            self.tracer.record(req.trace_id, "queued", req.submit_t,
+                               queued_ms)
+            self.tracer.record(req.trace_id, "finish", req.done_t, 0.0,
+                               reason="expired", tokens=0)
+            self._m_requests.labels(reason="expired").inc()
             req.stream._finish("expired")
             self.metrics.summary(
                 "request", rid=req.rid, reason="expired", tokens=0,
-                queued_ms=round((req.done_t - req.submit_t) * 1e3, 3),
+                queued_ms=round(queued_ms, 3),
             )
         for req in admitted:
             self._prefill_into(free.pop(0), req)
+        return len(admitted)
 
     def _prefill_into(self, slot: int, req: Request):
+        now = time.monotonic()
+        self.tracer.record(req.trace_id, "queued", req.submit_t,
+                           (now - req.submit_t) * 1e3)
         prefill = _prefill_fn(self._dm_one)
         prompt = jnp.asarray(req.prompt, jnp.int32)[None]
+        t0 = time.perf_counter()
         self._cache, self._last_logits = prefill(
             self._params_only, self._cache, self._last_logits,
             prompt, jnp.int32(slot),
@@ -278,6 +349,13 @@ class ServingEngine:
         self._rngs = self._rngs.at[slot].set(jax.random.PRNGKey(req.seed))
         self._slots[slot] = _SlotState(req=req,
                                        remaining=req.max_new_tokens)
+        # dispatch time only — no forced sync here; the tick's own
+        # host fetch is the hot path's one synchronization point
+        prefill_ms = (time.perf_counter() - t0) * 1e3
+        req.prefill_done_t = time.monotonic()
+        self.tracer.record(req.trace_id, "prefill", now, prefill_ms,
+                           slot=slot, prompt_tokens=int(req.prompt.size))
+        self._m_prefill_ms.observe(prefill_ms)
 
     def _decode_tick(self):
         cfgs = tuple(
@@ -296,6 +374,7 @@ class ServingEngine:
         occupancy = sum(st is not None for st in self._slots)
         self._occ_sum += occupancy
         now = time.monotonic()
+        emitted = 0
         for s, st in enumerate(self._slots):
             if st is None:
                 continue
@@ -304,17 +383,28 @@ class ServingEngine:
             if req.first_token_t is None:
                 # TTFT lands in the per-request summary at completion
                 req.first_token_t = now
+                self._m_ttft_ms.observe(
+                    (now - req.submit_t) * 1e3
+                )
             req.stream._put(tok)
             req.n_emitted += 1
             st.remaining -= 1
             self.tokens_generated += 1
+            emitted += 1
             if req.eos_id is not None and tok == req.eos_id:
                 self._complete(s, "eos")
             elif st.remaining == 0:
                 self._complete(s, "length")
+        queue_depth = self.scheduler.depth()
+        self._m_ticks.inc()
+        self._m_tokens.inc(emitted)
+        self._m_occupancy.set(sum(st is not None for st in self._slots))
+        self._m_tick_ms.observe(tick_ms)
+        if tick_ms > 0:
+            self._m_decode_tps.set(round(emitted / (tick_ms / 1e3), 3))
         self.metrics.log(
             step=self.ticks, occupancy=occupancy,
-            queue_depth=self.scheduler.depth(),
+            queue_depth=queue_depth,
             token_ms=round(tick_ms, 3),
         )
 
@@ -322,6 +412,20 @@ class ServingEngine:
         st = self._slots[slot]
         req = st.req
         req.done_t = time.monotonic()
+        # spans first, then the stream-end sentinel: a client that saw
+        # "done" can immediately trace_dump and find the full chain
+        decode_t0 = req.prefill_done_t or req.submit_t
+        self.tracer.record(
+            req.trace_id, "decode", decode_t0,
+            (req.done_t - decode_t0) * 1e3,
+            slot=slot, tokens=req.n_emitted,
+        )
+        self.tracer.record(
+            req.trace_id, "finish", req.done_t, 0.0,
+            reason=reason, slot=slot, tokens=req.n_emitted,
+            ttft_ms=round((req.first_token_t - req.submit_t) * 1e3, 3),
+        )
+        self._m_requests.labels(reason=reason).inc()
         req.stream._finish(reason)
         self._slots[slot] = None
         self.requests_completed += 1
@@ -334,7 +438,10 @@ class ServingEngine:
     # -- observability ------------------------------------------------------
 
     def stats(self) -> dict:
-        """Counters + latency percentiles (TTFT and per-token, ms)."""
+        """Counters + latency percentiles (TTFT and per-token, ms) for
+        THIS engine. The process-cumulative view (histograms, labeled
+        series) is ``self.registry.collect()`` — served by the TCP
+        ``metrics`` op and the HTTP endpoint."""
         return {
             "ticks": self.ticks,
             "requests_completed": self.requests_completed,

@@ -25,6 +25,8 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
+from distkeras_tpu import telemetry
+
 
 class QueueFullError(RuntimeError):
     """Admission queue is at ``max_queue_depth`` — the engine is not
@@ -96,10 +98,14 @@ class Request:
     deadline_s: Optional[float] = None
     rid: int = field(default_factory=lambda: next(_rid_counter))
     stream: TokenStream = field(default_factory=TokenStream)
+    # telemetry: allocated by FIFOScheduler.submit, carried end-to-end
+    # (TCP acks return it so clients can query trace_dump)
+    trace_id: Optional[int] = None
     # engine bookkeeping (monotonic timestamps)
     submit_t: Optional[float] = None
     first_token_t: Optional[float] = None
     done_t: Optional[float] = None
+    prefill_done_t: Optional[float] = None
     n_emitted: int = 0
 
 
@@ -109,7 +115,9 @@ class FIFOScheduler:
     handler threads while the engine pops from its loop thread."""
 
     def __init__(self, max_queue_depth: int = 256,
-                 max_prefills_per_tick: int = 2):
+                 max_prefills_per_tick: int = 2,
+                 tracer: Optional["telemetry.Tracer"] = None,
+                 registry: Optional["telemetry.MetricRegistry"] = None):
         if max_queue_depth < 1:
             raise ValueError(
                 f"max_queue_depth must be >= 1; got {max_queue_depth}"
@@ -123,17 +131,44 @@ class FIFOScheduler:
         self.max_prefills_per_tick = max_prefills_per_tick
         self._q: deque = deque()
         self._lock = threading.Lock()
+        self.tracer = tracer or telemetry.get_tracer()
+        self.registry = registry or telemetry.get_registry()
+        self._wire_metrics()
+
+    def _wire_metrics(self):
+        """(Re)resolve metric handles from the current registry — the
+        engine calls this after adopting an externally-built scheduler
+        into its own registry."""
+        self._m_depth = self.registry.gauge(
+            "serving_queue_depth", "requests waiting for a decode slot"
+        )
+        self._m_submitted = self.registry.counter(
+            "serving_requests_submitted_total",
+            "requests accepted into the admission queue",
+        )
+        self._m_rejected = self.registry.counter(
+            "serving_requests_rejected_total",
+            "submissions refused by queue backpressure",
+        )
 
     def submit(self, req: Request) -> Request:
-        """Enqueue or raise :class:`QueueFullError` (backpressure)."""
+        """Enqueue or raise :class:`QueueFullError` (backpressure).
+        Allocates the request's trace id — admission is where a request
+        enters the system, so the whole span chain shares this id."""
+        if req.trace_id is None:
+            req.trace_id = self.tracer.new_trace_id()
         with self._lock:
             if len(self._q) >= self.max_queue_depth:
+                self._m_rejected.inc()
                 raise QueueFullError(
                     f"admission queue full "
                     f"(max_queue_depth={self.max_queue_depth})"
                 )
             req.submit_t = time.monotonic()
             self._q.append(req)
+            depth = len(self._q)
+        self._m_submitted.inc()
+        self._m_depth.set(depth)
         return req
 
     def depth(self) -> int:
@@ -159,4 +194,7 @@ class FIFOScheduler:
                     expired.append(self._q.popleft())
                     continue
                 admitted.append(self._q.popleft())
+            depth = len(self._q)
+        if admitted or expired:
+            self._m_depth.set(depth)
         return admitted, expired

@@ -13,13 +13,22 @@ Protocol (all frames are msgpack dicts):
      "temperature"?, "seed"?, "eos_id"?, "top_k"?, "top_p"?,
      "deadline_s"?}
     {"op": "stats"}
+    {"op": "metrics"}                         # registry snapshot
+    {"op": "trace_dump", "trace"?: tid, "limit"?: n}
 
   server → client
-    {"ok": 1, "id": rid}                      # generate accepted
+    {"ok": 1, "id": rid, "trace": tid}        # generate accepted
     {"ok": 0, "error": msg}                   # rejected (e.g. backpressure)
     {"id": rid, "t": tok}                     # one streamed token
     {"id": rid, "done": 1, "reason": r, "n": k}   # stream end
     {"ok": 1, "stats": {...}}                 # stats reply
+    {"ok": 1, "metrics": {...}}               # MetricRegistry.collect()
+    {"ok": 1, "spans": [...]}                 # Tracer.dump()
+
+The ``trace`` id in the generate ack is the request's telemetry trace id
+(allocated at admission): ``trace_dump`` filtered to it returns the full
+span chain (queued/prefill/decode/finish + this connection's stream
+span).
 
 Tokens stream as the engine emits them — a connection may hold many
 in-flight requests, so frames are tagged with the request id and the
@@ -105,7 +114,10 @@ class LMServer:
 
     def _pump(self, conn, lock, req):
         """Forward one request's token stream to the client."""
+        import time
+
         n = 0
+        t0 = time.monotonic()
         try:
             for tok in req.stream:
                 self._send(conn, lock, {"id": req.rid, "t": int(tok)})
@@ -114,11 +126,19 @@ class LMServer:
                 "id": req.rid, "done": 1,
                 "reason": req.stream.finish_reason, "n": n,
             })
+            self.engine.tracer.record(
+                req.trace_id, "stream", t0,
+                (time.monotonic() - t0) * 1e3, tokens=n,
+            )
         except (ConnectionError, OSError):
             # client went away mid-stream: drain silently (the engine
             # finishes the request; its tokens are simply dropped)
             for _ in req.stream:
                 pass
+            self.engine.tracer.record(
+                req.trace_id, "stream", t0,
+                (time.monotonic() - t0) * 1e3, tokens=n, aborted=1,
+            )
 
     def _handle(self, conn: socket.socket):
         lock = threading.Lock()
@@ -151,7 +171,8 @@ class LMServer:
                         )
                         # ack BEFORE the pump starts so the acceptance
                         # frame always precedes the first token frame
-                        self._send(conn, lock, {"ok": 1, "id": req.rid})
+                        self._send(conn, lock, {"ok": 1, "id": req.rid,
+                                                "trace": req.trace_id})
                         t = threading.Thread(
                             target=self._pump, args=(conn, lock, req),
                             daemon=True,
@@ -161,6 +182,19 @@ class LMServer:
                     elif op == "stats":
                         self._send(conn, lock,
                                    {"ok": 1, "stats": self.engine.stats()})
+                    elif op == "metrics":
+                        self._send(conn, lock, {
+                            "ok": 1,
+                            "metrics": self.engine.registry.collect(),
+                        })
+                    elif op == "trace_dump":
+                        spans = self.engine.tracer.dump(
+                            trace=(None if msg.get("trace") is None
+                                   else int(msg["trace"])),
+                            limit=(None if msg.get("limit") is None
+                                   else int(msg["limit"])),
+                        )
+                        self._send(conn, lock, {"ok": 1, "spans": spans})
                     else:
                         self._send(conn, lock,
                                    {"ok": 0, "error": f"unknown op {op!r}"})
@@ -192,6 +226,7 @@ class ServingClient:
         self._acks: _queue.Queue = _queue.Queue()
         self._streams: Dict[int, _queue.Queue] = {}
         self._streams_lock = threading.Lock()
+        self._trace_ids: Dict[int, int] = {}  # rid -> telemetry trace id
         self._closed = False
         self._reader = threading.Thread(target=self._read_loop, daemon=True)
         self._reader.start()
@@ -236,13 +271,18 @@ class ServingClient:
 
     def generate(self, prompt, max_new_tokens: int, **kw) -> int:
         """Submit one request; returns its id (stream via
-        :meth:`stream` / :meth:`result`). Raises RuntimeError on
-        rejection (e.g. queue backpressure)."""
+        :meth:`stream` / :meth:`result`; telemetry trace id via
+        :meth:`trace_of`). Raises RuntimeError on rejection (e.g.
+        queue backpressure)."""
         msg = {"op": "generate",
                "prompt": [int(t) for t in prompt],
                "max_new_tokens": int(max_new_tokens)}
         msg.update({k: v for k, v in kw.items() if v is not None})
-        return int(self._call(msg)["id"])
+        reply = self._call(msg)
+        rid = int(reply["id"])
+        if reply.get("trace") is not None:
+            self._trace_ids[rid] = int(reply["trace"])
+        return rid
 
     def stream(self, rid: int):
         """Yield tokens for a request as they arrive."""
@@ -266,6 +306,24 @@ class ServingClient:
 
     def stats(self) -> dict:
         return dict(self._call({"op": "stats"})["stats"])
+
+    def metrics(self) -> dict:
+        """The server's :meth:`MetricRegistry.collect` snapshot."""
+        return dict(self._call({"op": "metrics"})["metrics"])
+
+    def trace_of(self, rid: int) -> Optional[int]:
+        """Telemetry trace id for a request this client submitted."""
+        return self._trace_ids.get(rid)
+
+    def trace_dump(self, trace: Optional[int] = None,
+                   limit: Optional[int] = None) -> List[dict]:
+        """Server-side span records (optionally one trace id's chain)."""
+        msg: dict = {"op": "trace_dump"}
+        if trace is not None:
+            msg["trace"] = int(trace)
+        if limit is not None:
+            msg["limit"] = int(limit)
+        return list(self._call(msg)["spans"])
 
     def close(self):
         try:

@@ -1,0 +1,55 @@
+"""Unified telemetry: request tracing, metric registry, live exposition.
+
+Three pieces, one import surface:
+
+- :mod:`~distkeras_tpu.telemetry.trace` — per-request span tracing
+  (``Tracer``): trace ids allocated at admission, spans recorded by every
+  subsystem a request crosses, queryable live (``trace_dump`` ops,
+  ``/traces``) or offline (JSONL + the ``report`` CLI).
+- :mod:`~distkeras_tpu.telemetry.registry` — Prometheus-style
+  counters/gauges/histograms (``MetricRegistry``) that the serving
+  engine, scheduler, parameter-server service, and trainers publish
+  into; one process-global default, isolated instances on demand.
+- :mod:`~distkeras_tpu.telemetry.exposition` — the scrape side:
+  Prometheus text rendering and a stdlib-HTTP ``TelemetryServer``
+  (``/metrics``, ``/metrics.json``, ``/traces``, ``/healthz``).
+
+Offline analysis: ``python -m distkeras_tpu.telemetry.report trace.jsonl``.
+
+This package is stdlib-only (no jax import) so instrumentation can never
+perturb device code, and every subsystem can import it without cycles.
+"""
+
+from distkeras_tpu.telemetry.exposition import (  # noqa: F401
+    TelemetryServer,
+    render_prometheus,
+)
+from distkeras_tpu.telemetry.registry import (  # noqa: F401
+    FRACTION_BUCKETS,
+    LATENCY_MS_BUCKETS,
+    STALENESS_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricRegistry,
+    get_registry,
+)
+from distkeras_tpu.telemetry.trace import (  # noqa: F401
+    Tracer,
+    get_tracer,
+)
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricRegistry",
+    "get_registry",
+    "Tracer",
+    "get_tracer",
+    "TelemetryServer",
+    "render_prometheus",
+    "LATENCY_MS_BUCKETS",
+    "STALENESS_BUCKETS",
+    "FRACTION_BUCKETS",
+]

@@ -1,0 +1,168 @@
+"""Exposition: Prometheus text rendering + a stdlib HTTP scrape endpoint.
+
+Two consumers read the registry/tracer: the framed-msgpack ``stats`` /
+``trace_dump`` ops on the existing servers (pull model, same transport
+the workers already speak), and this module's HTTP endpoint (what an
+actual Prometheus/Grafana stack scrapes). The HTTP server is
+``http.server`` from the stdlib — no new dependency — threaded so a slow
+scraper never blocks another, and bound to loopback unless told
+otherwise (same hardening posture as :class:`ParameterServerService`).
+
+Routes:
+
+    /metrics        Prometheus text exposition format (text/plain)
+    /metrics.json   the same snapshot as JSON
+    /traces         recent spans as JSON; ?trace=<id> filters one
+                    request, ?limit=<n> truncates
+    /healthz        200 "ok" (liveness probe)
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+from distkeras_tpu.telemetry.registry import MetricRegistry, get_registry
+from distkeras_tpu.telemetry.trace import Tracer, get_tracer
+
+
+def _escape_label(v: str) -> str:
+    return v.replace("\\", r"\\").replace('"', r'\"').replace("\n", r"\n")
+
+
+def _fmt_labels(labels: dict, extra: Optional[dict] = None) -> str:
+    merged = dict(labels)
+    if extra:
+        merged.update(extra)
+    if not merged:
+        return ""
+    inner = ",".join(
+        f'{k}="{_escape_label(str(v))}"' for k, v in merged.items()
+    )
+    return "{" + inner + "}"
+
+
+def _fmt_value(v: float) -> str:
+    f = float(v)
+    return repr(int(f)) if f == int(f) else repr(f)
+
+
+def render_prometheus(registry: Optional[MetricRegistry] = None) -> str:
+    """The registry as Prometheus text exposition format v0.0.4."""
+    registry = registry or get_registry()
+    lines = []
+    for name, snap in sorted(registry.collect().items()):
+        if snap["help"]:
+            lines.append(f"# HELP {name} {snap['help']}")
+        lines.append(f"# TYPE {name} {snap['type']}")
+        for series in snap["series"]:
+            labels = series["labels"]
+            if snap["type"] == "histogram":
+                # buckets are already cumulative-ready counts per bucket;
+                # Prometheus wants cumulative le= counts
+                cum = 0
+                for le, c in series["buckets"].items():
+                    cum += c
+                    lines.append(
+                        f"{name}_bucket"
+                        f"{_fmt_labels(labels, {'le': le})} {cum}"
+                    )
+                lines.append(
+                    f"{name}_sum{_fmt_labels(labels)} "
+                    f"{_fmt_value(series['sum'])}"
+                )
+                lines.append(
+                    f"{name}_count{_fmt_labels(labels)} {series['count']}"
+                )
+            else:
+                lines.append(
+                    f"{name}{_fmt_labels(labels)} "
+                    f"{_fmt_value(series['value'])}"
+                )
+    return "\n".join(lines) + "\n"
+
+
+class TelemetryServer:
+    """Threaded HTTP scrape endpoint over a registry + tracer pair.
+
+    ``port=0`` binds an ephemeral port (read ``.port`` after
+    construction). ``start()`` returns self so the one-liner works::
+
+        srv = TelemetryServer(port=9100).start()   # global registry/tracer
+        ... curl localhost:9100/metrics ...
+        srv.stop()
+    """
+
+    def __init__(self, registry: Optional[MetricRegistry] = None,
+                 tracer: Optional[Tracer] = None,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.registry = registry or get_registry()
+        self.tracer = tracer or get_tracer()
+        outer = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # no per-scrape stderr spam
+                pass
+
+            def _reply(self, code: int, body: str, ctype: str):
+                data = body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                url = urlparse(self.path)
+                q = parse_qs(url.query)
+                try:
+                    if url.path == "/metrics":
+                        self._reply(
+                            200, render_prometheus(outer.registry),
+                            "text/plain; version=0.0.4",
+                        )
+                    elif url.path == "/metrics.json":
+                        self._reply(
+                            200, json.dumps(outer.registry.collect()),
+                            "application/json",
+                        )
+                    elif url.path == "/traces":
+                        trace = (int(q["trace"][0])
+                                 if "trace" in q else None)
+                        limit = (int(q["limit"][0])
+                                 if "limit" in q else None)
+                        self._reply(
+                            200,
+                            json.dumps(outer.tracer.dump(trace=trace,
+                                                         limit=limit)),
+                            "application/json",
+                        )
+                    elif url.path == "/healthz":
+                        self._reply(200, "ok", "text/plain")
+                    else:
+                        self._reply(404, "not found", "text/plain")
+                except Exception as e:  # a bad scrape must not kill serving
+                    self._reply(500, f"{type(e).__name__}: {e}",
+                                "text/plain")
+
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "TelemetryServer":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 5.0):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None

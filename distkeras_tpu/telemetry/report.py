@@ -1,0 +1,142 @@
+"""Render a trace JSONL into per-request timelines + a summary table.
+
+    python -m distkeras_tpu.telemetry.report /tmp/trace.jsonl
+    python -m distkeras_tpu.telemetry.report /tmp/trace.jsonl --trace 17
+    python -m distkeras_tpu.telemetry.report /tmp/trace.jsonl --top 5
+
+Input is what :class:`~distkeras_tpu.telemetry.trace.Tracer` mirrors to
+``path=`` (or a saved ``trace_dump`` / ``/traces`` response, one span
+per line). Output answers the question the JSONL alone doesn't: *where
+did request N spend its time* — an aligned per-span timeline bar per
+trace, plus per-span-name duration percentiles across all traces.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from collections import defaultdict
+from typing import Dict, List, Optional, TextIO
+
+_BAR_WIDTH = 40
+
+
+def load_spans(path: str) -> List[dict]:
+    spans = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if line:
+                spans.append(json.loads(line))
+    return spans
+
+
+def _percentile(vals: List[float], p: float) -> float:
+    vals = sorted(vals)
+    rank = (len(vals) - 1) * p / 100.0
+    lo = int(rank)
+    hi = min(lo + 1, len(vals) - 1)
+    return vals[lo] + (vals[hi] - vals[lo]) * (rank - lo)
+
+
+def render_timeline(spans: List[dict], trace: int,
+                    out: Optional[TextIO] = None):
+    """One request's spans as offset-aligned bars (offsets relative to
+    the trace's earliest span start)."""
+    out = out or sys.stdout
+    mine = sorted(
+        (s for s in spans if s["trace"] == trace), key=lambda s: s["t0"]
+    )
+    if not mine:
+        out.write(f"trace {trace}: no spans\n")
+        return
+    base = mine[0]["t0"]
+    end = max(s["t0"] + s["ms"] / 1e3 for s in mine)
+    total_ms = max((end - base) * 1e3, 1e-9)
+    out.write(f"trace {trace}  ({total_ms:.1f} ms total)\n")
+    for s in mine:
+        off_ms = (s["t0"] - base) * 1e3
+        lo = int(off_ms / total_ms * _BAR_WIDTH)
+        ln = max(1, int(s["ms"] / total_ms * _BAR_WIDTH))
+        bar = " " * lo + "#" * min(ln, _BAR_WIDTH - lo)
+        attrs = {k: v for k, v in s.items()
+                 if k not in ("trace", "span", "t0", "ms")}
+        attr_str = ("  " + " ".join(f"{k}={v}" for k, v in attrs.items())
+                    if attrs else "")
+        out.write(
+            f"  {s['span']:<10} {bar:<{_BAR_WIDTH}} "
+            f"+{off_ms:8.1f}ms  {s['ms']:8.1f}ms{attr_str}\n"
+        )
+
+
+def render_summary(spans: List[dict], out: Optional[TextIO] = None):
+    """Per-span-name duration stats across every trace in the file."""
+    out = out or sys.stdout
+    by_name: Dict[str, List[float]] = defaultdict(list)
+    for s in spans:
+        by_name[s["span"]].append(float(s["ms"]))
+    traces = {s["trace"] for s in spans}
+    out.write(
+        f"\n{len(spans)} spans across {len(traces)} traces\n"
+    )
+    out.write(
+        f"  {'span':<12} {'count':>6} {'p50 ms':>10} "
+        f"{'p90 ms':>10} {'p99 ms':>10} {'max ms':>10}\n"
+    )
+    for name, vals in sorted(by_name.items()):
+        out.write(
+            f"  {name:<12} {len(vals):>6} "
+            f"{_percentile(vals, 50):>10.2f} "
+            f"{_percentile(vals, 90):>10.2f} "
+            f"{_percentile(vals, 99):>10.2f} "
+            f"{max(vals):>10.2f}\n"
+        )
+
+
+def report(path: str, trace: Optional[int] = None, top: int = 10,
+           out: Optional[TextIO] = None):
+    out = out or sys.stdout
+    spans = load_spans(path)
+    if not spans:
+        out.write(f"{path}: no spans\n")
+        return
+    if trace is not None:
+        render_timeline(spans, trace, out)
+        return
+    # longest-total traces first: the ones worth looking at
+    totals: Dict[int, float] = defaultdict(float)
+    for s in spans:
+        totals[s["trace"]] += float(s["ms"])
+    worst = sorted(totals, key=totals.get, reverse=True)[:top]
+    for tid in worst:
+        render_timeline(spans, tid, out)
+    if len(totals) > len(worst):
+        out.write(
+            f"  ... {len(totals) - len(worst)} more traces "
+            f"(--top to widen, --trace <id> for one)\n"
+        )
+    render_summary(spans, out)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Render a telemetry trace JSONL into per-request "
+                    "timelines and a span summary table."
+    )
+    ap.add_argument("path", help="trace JSONL (Tracer path= mirror)")
+    ap.add_argument("--trace", type=int, default=None,
+                    help="render only this trace id")
+    ap.add_argument("--top", type=int, default=10,
+                    help="how many longest traces to render (default 10)")
+    args = ap.parse_args(argv)
+    try:
+        report(args.path, trace=args.trace, top=args.top)
+    except BrokenPipeError:  # `... | head` closed the pipe: not an error
+        import os
+
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+
+
+if __name__ == "__main__":
+    main()

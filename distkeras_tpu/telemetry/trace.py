@@ -1,0 +1,132 @@
+"""Per-request span tracing (Dapper-style, sized for one process).
+
+A *trace* is one request's journey; a *span* is one named, timed segment
+of it. Trace ids are allocated where a request first enters the system
+(:meth:`FIFOScheduler.submit` for serving, the remote-PS proxy for
+pull/commit ops), carried on the request/message, and every subsystem the
+request crosses records spans against that id:
+
+    serving   queued → prefill → decode → finish   (engine)
+                                  stream           (TCP pump, per client)
+    PS ops    ps.rpc.<op> (client side) · ps.<op> (service side)
+
+Spans land in a bounded ring buffer (old traces age out; a serving
+process never grows without bound) and, when a path is configured, in an
+append-only JSONL file that ``python -m distkeras_tpu.telemetry.report``
+renders into per-request timelines. ``dump()`` is the live query the
+msgpack ``trace_dump`` op and the HTTP ``/traces`` endpoint serve.
+
+Span records are plain dicts — msgpack/json serializable as-is:
+
+    {"trace": 17, "span": "decode", "t0": <monotonic s>, "ms": 41.2,
+     "slot": 3, "tokens": 16, ...}
+
+``t0`` is ``time.monotonic()`` so offsets *within* a process are exact;
+cross-process alignment is out of scope (single-host serving is the
+target; see ROADMAP).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import itertools
+import json
+import threading
+import time
+from collections import deque
+from typing import List, Optional
+
+
+class Tracer:
+    """Thread-safe span sink: ring buffer + optional JSONL mirror.
+
+    ``capacity`` bounds the ring in *spans* (a serving request emits
+    ~4–5); ``path`` mirrors every span to JSONL for offline analysis.
+    All methods are safe from any thread — the engine loop, TCP handler
+    threads, and PS worker threads all write concurrently.
+    """
+
+    def __init__(self, capacity: int = 4096, path: Optional[str] = None):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1; got {capacity}")
+        self.path = path
+        self._buf: deque = deque(maxlen=capacity)
+        self._fh = open(path, "a") if path else None
+        self._lock = threading.Lock()
+        self._ids = itertools.count(1)
+
+    def new_trace_id(self) -> int:
+        """Allocate a process-unique trace id (itertools.count is
+        atomic under the GIL; no lock needed)."""
+        return next(self._ids)
+
+    # -- recording ----------------------------------------------------------
+
+    def record(self, trace: Optional[int], span: str, t0: float,
+               ms: float, **attrs):
+        """Append one finished span. ``t0`` is the span's start on the
+        monotonic clock; ``ms`` its duration. None attrs are dropped so
+        records stay msgpack/json-clean."""
+        if trace is None:
+            return  # untraced caller (e.g. a local PS pull): no-op
+        rec = {"trace": int(trace), "span": str(span),
+               "t0": round(float(t0), 6), "ms": round(float(ms), 3)}
+        for k, v in attrs.items():
+            if v is not None:
+                rec[k] = v
+        with self._lock:
+            self._buf.append(rec)
+            if self._fh is not None:
+                self._fh.write(json.dumps(rec) + "\n")
+
+    @contextlib.contextmanager
+    def span(self, trace: Optional[int], name: str, **attrs):
+        """``with tracer.span(tid, "ps.pull"):`` — times the block."""
+        t0 = time.monotonic()
+        try:
+            yield
+        finally:
+            self.record(trace, name, t0, (time.monotonic() - t0) * 1e3,
+                        **attrs)
+
+    # -- querying -----------------------------------------------------------
+
+    def dump(self, trace: Optional[int] = None,
+             limit: Optional[int] = None) -> List[dict]:
+        """Spans in arrival order, optionally filtered to one trace id
+        and/or truncated to the most recent ``limit``."""
+        with self._lock:
+            spans = list(self._buf)
+        if trace is not None:
+            spans = [s for s in spans if s["trace"] == int(trace)]
+        if limit is not None and limit >= 0:
+            spans = spans[-limit:]
+        return spans
+
+    def clear(self):
+        with self._lock:
+            self._buf.clear()
+
+    def close(self):
+        """Flush and close the JSONL mirror (idempotent); the ring
+        buffer stays queryable."""
+        with self._lock:
+            if self._fh is not None:
+                self._fh.flush()
+                self._fh.close()
+                self._fh = None
+
+    def __enter__(self) -> "Tracer":
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+_global_tracer = Tracer()
+
+
+def get_tracer() -> Tracer:
+    """The process-global tracer every subsystem defaults to."""
+    return _global_tracer

@@ -98,6 +98,12 @@ class Trainer:
 
     def record_training_start(self):
         self._t_start = time.time()
+        from distkeras_tpu import telemetry
+
+        telemetry.get_registry().counter(
+            "train_runs_total", "trainer.train() invocations",
+            labelnames=("trainer",),
+        ).labels(trainer=type(self).__name__).inc()
         if self.metrics_path is not None:
             from distkeras_tpu.utils.metrics import MetricsWriter
 
@@ -113,6 +119,15 @@ class Trainer:
 
     def record_training_end(self):
         self._t_end = time.time()
+        from distkeras_tpu import telemetry
+
+        telemetry.get_registry().gauge(
+            "train_last_run_seconds",
+            "wall-clock duration of the most recent train() call",
+            labelnames=("trainer",),
+        ).labels(trainer=type(self).__name__).set(
+            round(self._t_end - self._t_start, 3)
+        )
         if self._trace_cm is not None:
             self._trace_cm.__exit__(None, None, None)
             self._trace_cm = None

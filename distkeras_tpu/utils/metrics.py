@@ -79,7 +79,8 @@ class MetricsWriter:
 
     def throughput(self) -> Optional[float]:
         """Overall samples/sec across logged records (None without samples)."""
-        with_samples = [r for r in self._records if "samples" in r]
+        with self._lock:
+            with_samples = [r for r in self._records if "samples" in r]
         if len(with_samples) < 2:
             return None
         total = sum(r["samples"] for r in with_samples[1:])
@@ -87,10 +88,20 @@ class MetricsWriter:
         return total / dt if dt > 0 else None
 
     def close(self):
-        if self._fh:
-            self._fh.flush()
-            self._fh.close()
-            self._fh = None
+        """Flush and close the JSONL file (idempotent; records stay
+        queryable). Under the lock — async workers may be mid-append."""
+        with self._lock:
+            if self._fh:
+                self._fh.flush()
+                self._fh.close()
+                self._fh = None
+
+    def __enter__(self) -> "MetricsWriter":
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
 
 
 def staleness_histogram(staleness_log: List[int]) -> Dict[int, int]:

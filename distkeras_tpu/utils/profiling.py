@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import contextlib
 import time
+import warnings
 from typing import Optional
 
 import jax
@@ -40,11 +41,20 @@ class StepTimer:
         self._t = time.perf_counter()
 
     def stop(self, sync_on=None) -> float:
+        if self._t is None:
+            # stop() without start(): a 0.0 reading with a warning beats
+            # a TypeError from None arithmetic deep in a bench loop
+            warnings.warn(
+                "StepTimer.stop() called before start(); returning 0.0",
+                RuntimeWarning, stacklevel=2,
+            )
+            return 0.0
         if sync_on is not None:
             jax.tree.map(
                 lambda a: np.asarray(a) if hasattr(a, "dtype") else a, sync_on
             )
         dt = time.perf_counter() - self._t
+        self._t = None  # consumed: a second stop() warns, not double-counts
         self.durations.append(dt)
         return dt
 

@@ -30,9 +30,19 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from distkeras_tpu import telemetry
 from distkeras_tpu.ops import rules
 from distkeras_tpu.utils.history import History
 from distkeras_tpu.utils.losses import get_loss, get_optimizer, resolve_metrics
+
+# Live training progress for the scrape endpoint (per-window updates —
+# one locked add per completed window, nothing in the jitted loop).
+_TRAIN_STEPS = telemetry.get_registry().counter(
+    "train_steps_total", "optimizer steps completed across all workers",
+)
+_TRAIN_SAMPLES = telemetry.get_registry().counter(
+    "train_samples_total", "training samples consumed across all workers",
+)
 
 
 def make_train_step(
@@ -225,7 +235,11 @@ class Worker:
         self._step_count = 0
 
     def _log_steps(self, records: Sequence[Dict[str, float]]):
-        """Stream freshly-completed step records to the metrics writer."""
+        """Stream freshly-completed step records to the metrics writer
+        and the process-global registry."""
+        if records:
+            _TRAIN_STEPS.inc(len(records))
+            _TRAIN_SAMPLES.inc(len(records) * self.batch_size)
         w = self.metrics_writer
         if w is not None:
             for r in records:

@@ -6,7 +6,14 @@ transport, and prints each request's tokens as they stream back. Every
 stream is checked token-for-token against a solo ``generate()`` call —
 the continuous-batching engine is the same math, just scheduled.
 
+Telemetry: the server's engine publishes into the process-global
+registry/tracer; ``--telemetry-port`` starts the HTTP scrape endpoint
+(``/metrics`` Prometheus text, ``/metrics.json``, ``/traces``), and the
+example always prints the first request's span chain (queued → prefill →
+decode → stream → finish) fetched over the TCP ``trace_dump`` op.
+
 Run: python examples/lm_serving.py [--prompts 4] [--max-new 16] [--slots 2]
+     [--telemetry-port 9100]
 """
 
 import argparse
@@ -32,6 +39,9 @@ def main():
     ap.add_argument("--slots", type=int, default=2)
     ap.add_argument("--prompt-len", type=int, default=8)
     ap.add_argument("--vocab", type=int, default=128)
+    ap.add_argument("--telemetry-port", type=int, default=None,
+                    help="start the HTTP scrape endpoint on this port "
+                         "(0 = ephemeral)")
     args = ap.parse_args()
 
     model = get_model(
@@ -50,6 +60,16 @@ def main():
 
     engine = ServingEngine(model, params, slots=args.slots)
     server = LMServer(engine).start()
+    telemetry_server = None
+    if args.telemetry_port is not None:
+        from distkeras_tpu.telemetry import TelemetryServer
+
+        telemetry_server = TelemetryServer(
+            registry=engine.registry, tracer=engine.tracer,
+            port=args.telemetry_port,
+        ).start()
+        print(f"telemetry: http://127.0.0.1:{telemetry_server.port}"
+              f"/metrics (+ /metrics.json, /traces)")
     client = ServingClient("127.0.0.1", server.port)
     try:
         rids = [client.generate(p, max_new_tokens=args.max_new)
@@ -73,9 +93,18 @@ def main():
             f"(mean occupancy {stats['mean_occupancy']}, "
             f"ttft p50 {stats['ttft_ms']['p50']:.1f}ms)"
         )
+        # where did request 0 spend its time? — the span chain by trace id
+        spans = client.trace_dump(trace=client.trace_of(rids[0]))
+        for s in spans:
+            attrs = {k: v for k, v in s.items()
+                     if k not in ("trace", "span", "t0", "ms")}
+            print(f"  trace {s['trace']} {s['span']:<8} {s['ms']:8.2f}ms "
+                  + " ".join(f"{k}={v}" for k, v in attrs.items()))
     finally:
         client.close()
         server.stop()
+        if telemetry_server is not None:
+            telemetry_server.stop()
 
 
 if __name__ == "__main__":

@@ -46,3 +46,61 @@ def test_step_timer():
     x = jnp.arange(1000.0).sum()
     dt = t.stop(sync_on=x)
     assert dt > 0 and t.mean > 0
+
+
+def test_step_timer_stop_without_start_warns():
+    import pytest
+
+    t = StepTimer()
+    with pytest.warns(RuntimeWarning, match="before start"):
+        assert t.stop() == 0.0
+    assert t.durations == []  # no bogus sample recorded
+    # a consumed timer warns again instead of double-counting
+    t.start()
+    assert t.stop() >= 0.0
+    with pytest.warns(RuntimeWarning):
+        assert t.stop() == 0.0
+    assert len(t.durations) == 1
+
+
+def test_metrics_writer_context_manager(tmp_path):
+    path = tmp_path / "cm.jsonl"
+    with MetricsWriter(str(path)) as w:
+        w.log(step=0, samples=8, loss=1.0)
+    assert w._fh is None  # closed on exit
+    w.close()  # idempotent: second close is a no-op
+    lines = [json.loads(line) for line in path.read_text().splitlines()]
+    assert len(lines) == 1 and lines[0]["step"] == 0
+    # records remain queryable after close
+    assert w.records[0]["loss"] == 1.0
+
+
+def test_metrics_writer_throughput_concurrent_with_appends():
+    """throughput() reads under the lock: hammer it while workers
+    append (the async-trainer pattern) — no RuntimeError from the list
+    mutating mid-iteration, and the final figure is positive."""
+    import threading
+
+    w = MetricsWriter()
+    stop = threading.Event()
+    errors = []
+
+    def reader():
+        while not stop.is_set():
+            try:
+                w.throughput()
+            except Exception as e:  # pragma: no cover - failure detail
+                errors.append(e)
+                return
+
+    threads = [threading.Thread(target=reader) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for i in range(2000):
+        w.log(step=i, samples=32, worker=i % 4)
+    stop.set()
+    for t in threads:
+        t.join()
+    assert not errors
+    tp = w.throughput()
+    assert tp is not None and tp > 0

@@ -2,6 +2,7 @@
 generate(), same-tick EOS slot refill, queue backpressure/deadlines, the
 generate() eos early-exit, and a localhost TCP smoke test."""
 
+import threading
 import time
 
 import jax
@@ -206,6 +207,103 @@ def test_server_tcp_smoke():
         stats = client.stats()
         assert stats["requests_completed"] == 3
         assert stats["tokens_generated"] == 15
+        client.close()
+    finally:
+        server.stop()
+
+
+def test_server_stats_under_concurrent_inflight_requests():
+    """The stats/metrics ops answer correctly while requests are mid
+    stream: stats frames interleave with token frames on the same
+    connection without corrupting either, and the final counters agree
+    with what was streamed."""
+    from distkeras_tpu import telemetry
+
+    model, params = _model_and_params()
+    reg = telemetry.MetricRegistry()
+    eng = ServingEngine(model, params, slots=2, registry=reg,
+                        tracer=telemetry.Tracer())
+    server = LMServer(eng).start()
+    try:
+        client = ServingClient("127.0.0.1", server.port)
+        rng = np.random.default_rng(7)
+        prompts = [rng.integers(0, 64, size=5).astype(np.int32)
+                   for _ in range(4)]
+        rids = [client.generate(p, max_new_tokens=12) for p in prompts]
+        # hammer stats from a side thread while tokens stream
+        polled, errors = [], []
+
+        def poll():
+            try:
+                for _ in range(20):
+                    polled.append(client.stats())
+            except Exception as e:  # pragma: no cover - failure detail
+                errors.append(e)
+
+        t = threading.Thread(target=poll)
+        t.start()
+        results = {rid: client.result(rid, timeout=60) for rid in rids}
+        t.join(timeout=30)
+        assert not errors
+        assert len(polled) == 20
+        # monotone progress visible through the op
+        done_counts = [s["requests_completed"] for s in polled]
+        assert done_counts == sorted(done_counts)
+        for p, rid in zip(prompts, rids):
+            toks, reason = results[rid]
+            assert toks == _solo(model, params, p, max_new_tokens=12)
+            assert reason == "length"
+        final = client.stats()
+        assert final["requests_completed"] == 4
+        assert final["tokens_generated"] == 48
+        # registry snapshot over the wire agrees
+        metrics = client.metrics()
+        series = metrics["serving_tokens_total"]["series"]
+        assert series and series[0]["value"] == 48
+        client.close()
+    finally:
+        server.stop()
+
+
+def test_trace_id_roundtrip_via_client():
+    """Satellite: the generate ack carries the trace id allocated at
+    admission; trace_dump filtered to it returns the complete span chain
+    (queued/prefill/decode/finish + the connection's stream span) with
+    slot ids and token counts."""
+    from distkeras_tpu import telemetry
+
+    model, params = _model_and_params()
+    eng = ServingEngine(model, params, slots=2,
+                        registry=telemetry.MetricRegistry(),
+                        tracer=telemetry.Tracer())
+    server = LMServer(eng).start()
+    try:
+        client = ServingClient("127.0.0.1", server.port)
+        p = np.arange(1, 7, dtype=np.int32)
+        rid = client.generate(p, max_new_tokens=5)
+        tid = client.trace_of(rid)
+        assert tid is not None
+        # stream path (not result()): tokens arrive as emitted
+        toks = list(client.stream(rid))
+        assert toks == _solo(model, params, p, max_new_tokens=5)
+        # the engine records finish before the done frame is sent, so
+        # the chain is complete the moment the stream ends; the stream
+        # span itself is written by the pump thread right after done
+        deadline = time.monotonic() + 5.0
+        spans = {}
+        while time.monotonic() < deadline:
+            spans = {s["span"]: s for s in client.trace_dump(trace=tid)}
+            if "stream" in spans:
+                break
+            time.sleep(0.01)
+        assert set(spans) == {"queued", "prefill", "decode", "stream",
+                              "finish"}
+        assert spans["prefill"]["prompt_tokens"] == 6
+        assert spans["decode"]["tokens"] == 5
+        assert spans["stream"]["tokens"] == 5
+        assert spans["finish"]["reason"] == "length"
+        assert spans["finish"]["slot"] == spans["decode"]["slot"]
+        assert all(s["trace"] == tid for s in spans.values())
         client.close()
     finally:
         server.stop()

@@ -1,0 +1,385 @@
+"""Telemetry layer: metric registry, tracer, exposition (Prometheus text
++ HTTP endpoint), PS-service instrumentation, and the report CLI.
+
+The serving-side acceptance path (span chain via ServingClient +
+trace_dump, stats under concurrent load) lives in test_serving.py next
+to the other TCP serving tests.
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distkeras_tpu import telemetry
+from distkeras_tpu.telemetry import report as telemetry_report
+
+KW = dict(vocab_size=64, d_model=32, num_heads=2, num_layers=2,
+          max_len=48, dtype=jnp.float32, attention="dense")
+
+
+def _model_and_params(seed=0):
+    from distkeras_tpu.models import get_model
+
+    model = get_model("transformer_lm", **KW)
+    params = model.init(jax.random.PRNGKey(seed),
+                        jnp.zeros((1, 4), jnp.int32))
+    return model, params
+
+
+# -- registry ---------------------------------------------------------------
+
+
+def test_counter_gauge_basics():
+    reg = telemetry.MetricRegistry()
+    c = reg.counter("c_total", "a counter")
+    c.inc()
+    c.inc(2.5)
+    assert c.value == 3.5
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    g = reg.gauge("g", "a gauge")
+    g.set(7)
+    g.inc(-2)
+    assert g.value == 5.0
+    # get-or-create returns the same object; mismatches are errors
+    assert reg.counter("c_total") is c
+    with pytest.raises(ValueError):
+        reg.gauge("c_total")
+    with pytest.raises(ValueError):
+        reg.counter("c_total", labelnames=("x",))
+
+
+def test_labeled_series():
+    reg = telemetry.MetricRegistry()
+    c = reg.counter("ops_total", "ops", labelnames=("op",))
+    c.labels(op="pull").inc(3)
+    c.labels(op="commit").inc()
+    assert c.labels(op="pull").value == 3.0
+    with pytest.raises(ValueError):
+        c.inc()  # labeled metric requires .labels(...)
+    with pytest.raises(ValueError):
+        c.labels(wrong="x")
+    snap = reg.collect()["ops_total"]
+    assert snap["type"] == "counter"
+    got = {s["labels"]["op"]: s["value"] for s in snap["series"]}
+    assert got == {"pull": 3.0, "commit": 1.0}
+
+
+def test_histogram_buckets_and_percentile():
+    reg = telemetry.MetricRegistry()
+    h = reg.histogram("lat_ms", "latency", buckets=(1.0, 10.0, 100.0))
+    for v in (0.5, 2.0, 3.0, 50.0, 5000.0):
+        h.observe(v)
+    state = h.value
+    assert state["count"] == 5
+    assert state["sum"] == pytest.approx(5055.5)
+    assert state["buckets"] == {"1.0": 1, "10.0": 2, "100.0": 1, "+Inf": 1}
+    # bucket-interpolated percentile lands inside the right bucket
+    assert 1.0 <= h.percentile(50) <= 10.0
+    assert h.percentile(99) == 100.0  # +Inf clamps to the last bound
+    assert reg.histogram("empty", buckets=(1.0,)).percentile(50) is None
+
+
+def test_histogram_thread_safety():
+    reg = telemetry.MetricRegistry()
+    h = reg.histogram("h", buckets=(0.5,))
+    c = reg.counter("n")
+
+    def work():
+        for _ in range(1000):
+            h.observe(1.0)
+            c.inc()
+
+    threads = [threading.Thread(target=work) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert h.value["count"] == 8000
+    assert c.value == 8000
+
+
+def test_prometheus_rendering():
+    reg = telemetry.MetricRegistry()
+    reg.counter("req_total", "requests", labelnames=("reason",)) \
+        .labels(reason="eos").inc(2)
+    reg.gauge("depth", "queue depth").set(4)
+    h = reg.histogram("ms", "latency", buckets=(1.0, 10.0))
+    h.observe(0.5)
+    h.observe(5.0)
+    text = telemetry.render_prometheus(reg)
+    assert '# TYPE req_total counter' in text
+    assert 'req_total{reason="eos"} 2' in text
+    assert "depth 4" in text
+    # histogram: cumulative le buckets + sum + count
+    assert 'ms_bucket{le="1.0"} 1' in text
+    assert 'ms_bucket{le="10.0"} 2' in text
+    assert 'ms_bucket{le="+Inf"} 2' in text
+    assert "ms_sum 5.5" in text
+    assert "ms_count 2" in text
+
+
+# -- tracer -----------------------------------------------------------------
+
+
+def test_tracer_ring_and_jsonl(tmp_path):
+    path = tmp_path / "trace.jsonl"
+    tr = telemetry.Tracer(capacity=4, path=str(path))
+    ids = [tr.new_trace_id() for _ in range(3)]
+    assert len(set(ids)) == 3
+    for i, tid in enumerate(ids):
+        tr.record(tid, "work", t0=float(i), ms=1.5, slot=i, skip=None)
+    tr.record(ids[0], "extra", t0=9.0, ms=0.1)
+    tr.record(ids[0], "over", t0=10.0, ms=0.1)  # evicts the oldest
+    spans = tr.dump()
+    assert len(spans) == 4  # ring capacity
+    assert [s["span"] for s in tr.dump(trace=ids[0])] == ["extra", "over"]
+    assert tr.dump(limit=1)[0]["span"] == "over"
+    assert "skip" not in tr.dump(trace=ids[1])[0]  # None attrs dropped
+    # untraced records are no-ops
+    tr.record(None, "ignored", 0.0, 1.0)
+    assert all(s["span"] != "ignored" for s in tr.dump())
+    tr.close()
+    tr.close()  # idempotent
+    lines = [json.loads(x) for x in path.read_text().splitlines()]
+    assert len(lines) == 5  # JSONL mirror keeps everything, ring evicts
+
+
+def test_tracer_span_contextmanager():
+    tr = telemetry.Tracer()
+    tid = tr.new_trace_id()
+    with tr.span(tid, "block", op="x"):
+        pass
+    (s,) = tr.dump(trace=tid)
+    assert s["span"] == "block" and s["op"] == "x" and s["ms"] >= 0
+
+
+# -- engine span chain + registry (driven directly, no TCP) -----------------
+
+
+def test_engine_emits_span_chain_and_metrics():
+    from distkeras_tpu.serving import ServingEngine
+
+    model, params = _model_and_params()
+    reg, tr = telemetry.MetricRegistry(), telemetry.Tracer()
+    eng = ServingEngine(model, params, slots=2, registry=reg, tracer=tr)
+    reqs = [eng.submit(np.arange(1, 6, dtype=np.int32), max_new_tokens=4)
+            for _ in range(3)]
+    eng.drain()
+    for req in reqs:
+        req.stream.tokens(timeout=10)
+        chain = {s["span"]: s for s in tr.dump(trace=req.trace_id)}
+        assert set(chain) == {"queued", "prefill", "decode", "finish"}
+        assert chain["prefill"]["prompt_tokens"] == 5
+        assert chain["decode"]["tokens"] == 4
+        assert chain["finish"]["reason"] == "length"
+        assert chain["decode"]["slot"] == chain["finish"]["slot"]
+        assert chain["finish"]["slot"] in (0, 1)
+    assert reg.counter("serving_ticks_total").value == eng.ticks
+    assert reg.counter("serving_tokens_total").value == 12
+    assert reg.counter("serving_requests_total",
+                       labelnames=("reason",)).labels(
+                           reason="length").value == 3
+    assert reg.histogram("serving_ttft_ms").value["count"] == 3
+    assert reg.histogram("serving_token_ms").value["count"] == eng.ticks
+    assert reg.gauge("serving_slot_occupancy").value == 0  # drained
+    frac = reg.histogram("serving_prefill_fraction").value
+    assert frac["count"] > 0
+
+
+def test_expired_request_traced():
+    from distkeras_tpu.serving import ServingEngine
+
+    model, params = _model_and_params()
+    reg, tr = telemetry.MetricRegistry(), telemetry.Tracer()
+    eng = ServingEngine(model, params, slots=1, registry=reg, tracer=tr)
+    import time
+
+    req = eng.submit(np.zeros(4, np.int32), max_new_tokens=2,
+                     deadline_s=0.0)
+    time.sleep(0.01)
+    eng.drain()
+    assert req.stream.tokens(timeout=10) == []
+    chain = {s["span"] for s in tr.dump(trace=req.trace_id)}
+    assert chain == {"queued", "finish"}
+    assert reg.counter("serving_requests_total",
+                       labelnames=("reason",)).labels(
+                           reason="expired").value == 1
+
+
+# -- PS service: op latency, bytes, trace propagation, wire ops -------------
+
+
+def _tiny_tree():
+    return {"w": np.ones((4, 4), np.float32), "b": np.zeros(4, np.float32)}
+
+
+def test_ps_service_telemetry_and_wire_ops():
+    from distkeras_tpu.networking import (
+        ParameterServerService,
+        RemoteParameterServer,
+    )
+    from distkeras_tpu.parameter_servers import DeltaParameterServer
+
+    reg, tr = telemetry.MetricRegistry(), telemetry.Tracer()
+    ps = DeltaParameterServer(_tiny_tree())
+    service = ParameterServerService(ps, registry=reg, tracer=tr)
+    service.start()
+    try:
+        proxy = RemoteParameterServer("127.0.0.1", service.port)
+        pulled = proxy.pull()
+        np.testing.assert_allclose(pulled["w"], np.ones((4, 4)))
+        proxy.commit({"w": np.ones((4, 4), np.float32) * 0.5,
+                      "b": np.zeros(4, np.float32)})
+        assert proxy.num_updates == 1
+        # op latency histograms + counters, labeled by op
+        ops = reg.counter("ps_ops_total", labelnames=("op",))
+        assert ops.labels(op="pull").value == 1
+        assert ops.labels(op="commit").value == 1
+        lat = reg.histogram("ps_op_latency_ms", labelnames=("op",))
+        assert lat.labels(op="pull").value["count"] == 1
+        assert lat.labels(op="commit").value["count"] == 1
+        by = reg.counter("ps_op_bytes_total", labelnames=("op",))
+        assert by.labels(op="pull").value == 4 * 4 * 4 + 4 * 4
+        assert by.labels(op="commit").value == 4 * 4 * 4 + 4 * 4
+        # every proxied op carried a trace id -> ps.<op> service spans.
+        # The service records a span after its reply is sent, so the
+        # most recent op's span may land a beat after the client returns
+        # — poll briefly.
+        import time
+
+        deadline = time.monotonic() + 5.0
+        names = set()
+        while time.monotonic() < deadline:
+            names = {s["span"] for s in tr.dump()}
+            if {"ps.pull", "ps.commit", "ps.num_updates"} <= names:
+                break
+            time.sleep(0.01)
+        assert {"ps.pull", "ps.commit", "ps.num_updates"} <= names
+        # wire ops: stats carries the registry snapshot; trace_dump
+        # round-trips spans
+        stats = proxy.stats()
+        assert stats["num_updates"] == 1
+        assert "ps_op_latency_ms" in stats["metrics"]
+        spans = proxy.trace_dump()
+        assert {s["span"] for s in spans} >= {"ps.pull", "ps.commit"}
+        one = proxy.trace_dump(trace=spans[0]["trace"])
+        assert all(s["trace"] == spans[0]["trace"] for s in one)
+        proxy.close()
+    finally:
+        service.stop()
+
+
+def test_dynsgd_staleness_lands_in_global_histogram():
+    from distkeras_tpu.parameter_servers import DynSGDParameterServer
+
+    hist = telemetry.get_registry().histogram("ps_commit_staleness")
+    before = (hist.value or {"count": 0})["count"]
+    ps = DynSGDParameterServer(_tiny_tree())
+    for clock in (0, 0, 1):
+        ps.commit({"w": np.zeros((4, 4), np.float32),
+                   "b": np.zeros(4, np.float32)}, worker_clock=clock)
+    assert hist.value["count"] == before + 3
+    assert ps.staleness_log == [0, 1, 1]
+
+
+# -- HTTP exposition (acceptance: scrape a live server) ---------------------
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return resp.status, resp.read().decode()
+
+
+def test_http_endpoint_scrapes_live_serving_and_ps():
+    """One Prometheus endpoint over a live LMServer + PS service: queue
+    depth, slot occupancy, and PS op latency histograms all exposed."""
+    from distkeras_tpu.networking import (
+        ParameterServerService,
+        RemoteParameterServer,
+    )
+    from distkeras_tpu.parameter_servers import DeltaParameterServer
+    from distkeras_tpu.serving import LMServer, ServingClient, ServingEngine
+
+    model, params = _model_and_params()
+    reg, tr = telemetry.MetricRegistry(), telemetry.Tracer()
+    eng = ServingEngine(model, params, slots=2, registry=reg, tracer=tr)
+    lm = LMServer(eng).start()
+    ps_service = ParameterServerService(
+        DeltaParameterServer(_tiny_tree()), registry=reg, tracer=tr
+    )
+    ps_service.start()
+    http = telemetry.TelemetryServer(registry=reg, tracer=tr).start()
+    try:
+        client = ServingClient("127.0.0.1", lm.port)
+        rid = client.generate(list(range(1, 6)), max_new_tokens=4)
+        toks, reason = client.result(rid, timeout=60)
+        assert len(toks) == 4 and reason == "length"
+        proxy = RemoteParameterServer("127.0.0.1", ps_service.port)
+        proxy.pull()
+        proxy.close()
+        client.close()
+
+        code, text = _get(f"http://127.0.0.1:{http.port}/metrics")
+        assert code == 200
+        assert "serving_queue_depth" in text
+        assert "serving_slot_occupancy" in text
+        assert 'ps_op_latency_ms_bucket{op="pull",le="+Inf"} 1' in text
+        assert "serving_ttft_ms_count 1" in text
+
+        code, text = _get(f"http://127.0.0.1:{http.port}/metrics.json")
+        snap = json.loads(text)
+        assert snap["serving_tokens_total"]["series"][0]["value"] == 4
+
+        tid = client.trace_of(rid)
+        code, text = _get(
+            f"http://127.0.0.1:{http.port}/traces?trace={tid}"
+        )
+        spans = {s["span"] for s in json.loads(text)}
+        assert {"queued", "prefill", "decode", "finish"} <= spans
+
+        assert _get(f"http://127.0.0.1:{http.port}/healthz")[1] == "ok"
+        with pytest.raises(urllib.error.HTTPError):
+            _get(f"http://127.0.0.1:{http.port}/nope")
+    finally:
+        http.stop()
+        ps_service.stop()
+        lm.stop()
+
+
+# -- report CLI -------------------------------------------------------------
+
+
+def test_report_cli_renders_timeline_and_summary(tmp_path, capsys):
+    path = tmp_path / "trace.jsonl"
+    tr = telemetry.Tracer(path=str(path))
+    for tid, base in ((1, 0.0), (2, 5.0)):
+        tr.record(tid, "queued", base, 2.0)
+        tr.record(tid, "prefill", base + 0.002, 8.0, slot=0,
+                  prompt_tokens=5)
+        tr.record(tid, "decode", base + 0.010, 40.0, slot=0, tokens=16)
+        tr.record(tid, "finish", base + 0.050, 0.0, reason="length",
+                  tokens=16)
+    tr.close()
+    telemetry_report.main([str(path)])
+    out = capsys.readouterr().out
+    assert "trace 1" in out and "trace 2" in out
+    assert "decode" in out and "reason=length" in out
+    assert "8 spans across 2 traces" in out
+    # single-trace mode
+    telemetry_report.main([str(path), "--trace", "2"])
+    out = capsys.readouterr().out
+    assert "trace 2" in out and "trace 1" not in out
+
+
+def test_report_cli_empty_file(tmp_path, capsys):
+    path = tmp_path / "empty.jsonl"
+    path.write_text("")
+    telemetry_report.main([str(path)])
+    assert "no spans" in capsys.readouterr().out
