@@ -170,6 +170,19 @@ class VocabHead(nn.Module):
         return y + bias
 
 
+def _quantize_int8(x):
+    """Per-(token, head) symmetric int8 quantization for the KV cache:
+    ``[..., hd]`` → (int8 values, f32 scales over the last axis). f32
+    scales so tiny rows stay exact; the dequantize fuses into the attend
+    einsum so bf16 values never round-trip HBM."""
+    a = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
+    s = jnp.maximum(a / 127.0, 1e-8)
+    qx = jnp.clip(
+        jnp.round(x.astype(jnp.float32) / s[..., None]), -127, 127
+    ).astype(jnp.int8)
+    return qx, s
+
+
 class CausalSelfAttention(nn.Module):
     num_heads: int
     dtype: jnp.dtype = jnp.bfloat16
@@ -213,8 +226,95 @@ class CausalSelfAttention(nn.Module):
     # others. Requires decode=True; the math per row is identical to the
     # scalar-cursor path (parity-tested in tests/test_serving.py).
     slot_cursor: bool = False
+    # paged KV cache (the block-pooled serving engine, serving/kvpool.py
+    # + serving/prefix.py): the cache is [num_pages, page_block_size,
+    # Hk, hd] per layer — a pool of fixed-size token blocks shared by
+    # every sequence — and each call carries per-row block tables
+    # ([B, max_blocks] physical block ids) and sequence lengths ([B]
+    # cursors). K/V writes scatter to (table[pos // bs], pos % bs);
+    # the attend gathers each row's blocks back into a [B, L, Hk, hd]
+    # view, so the math (and under rope/GQA/int8, the bits) is the
+    # slot-cursor path's exactly. decode=True only; cursors live with
+    # the host scheduler, not in the cache collection.
+    paged: bool = False
+    page_block_size: int = 16
+    num_pages: int = 0
 
     _DENSE_MAX_T = 512  # short sequences: one fused dense block is fastest
+
+    def _paged_attend(self, q, k, v, block_tables, seq_lens):
+        """Paged twin of :meth:`_cached_attend`: same rope-at-cursor,
+        same grouped attend, same masks — but K/V live in the global
+        block pool and this row's view of it is assembled by gathering
+        its block table. Writes land at each token's (block, offset);
+        the caller guarantees a row only ever writes blocks it owns
+        exclusively (copy-on-write upstream), so the scatter never
+        races a shared prefix."""
+        B, T, H, hd = q.shape
+        Hk = k.shape[2]
+        G = H // Hk
+        bs = self.page_block_size
+        nb = self.num_pages
+        max_blocks = block_tables.shape[-1]
+        L = max_blocks * bs
+        quant = self.cache_dtype == "int8"
+        store = jnp.int8 if quant else self.dtype
+        ck = self.variable(
+            "cache", "paged_key", jnp.zeros, (nb, bs, Hk, hd), store
+        )
+        cv = self.variable(
+            "cache", "paged_value", jnp.zeros, (nb, bs, Hk, hd), store
+        )
+        if quant:
+            ks = self.variable(
+                "cache", "key_scale", jnp.ones, (nb, bs, Hk), jnp.float32
+            )
+            vs = self.variable(
+                "cache", "value_scale", jnp.ones, (nb, bs, Hk), jnp.float32
+            )
+        pos = seq_lens[:, None] + jnp.arange(T)  # [B, T] absolute
+        if self.rope:
+            q = apply_rope(q, pos)
+            k = apply_rope(k, pos)
+        # token t of row b lands in physical block table[pos // bs] at
+        # offset pos % bs; idle rows point at the reserved trash block
+        blk = jnp.take_along_axis(block_tables, pos // bs, axis=1)
+        off = pos % bs
+
+        def put(cache, new):
+            return cache.at[blk, off].set(new.astype(cache.dtype))
+
+        def view(cache):
+            # [B, max_blocks, bs, ...] gather -> the row-major [B, L,
+            # ...] layout the slot path attends over
+            g = cache[block_tables]
+            return g.reshape((B, L) + cache.shape[2:])
+
+        if quant:
+            kq, k_s = _quantize_int8(k)
+            vq, v_s = _quantize_int8(v)
+            ck.value = put(ck.value, kq)
+            cv.value = put(cv.value, vq)
+            ks.value = put(ks.value, k_s)
+            vs.value = put(vs.value, v_s)
+            keys = (view(ck.value).astype(jnp.float32)
+                    * view(ks.value)[..., None]).astype(self.dtype)
+            vals = (view(cv.value).astype(jnp.float32)
+                    * view(vs.value)[..., None]).astype(self.dtype)
+        else:
+            ck.value = put(ck.value, k)
+            cv.value = put(cv.value, v)
+            keys, vals = view(ck.value), view(cv.value)
+        scale = 1.0 / np.sqrt(hd)
+        qg = q.reshape(B, T, Hk, G, hd)
+        s = jnp.einsum(
+            "bqkgd,blkd->bkgql", qg, keys
+        ).astype(jnp.float32) * scale
+        mask = jnp.arange(L)[None, None, :] <= pos[..., None]  # [B, T, L]
+        s = jnp.where(mask[:, None, None], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        out = jnp.einsum("bkgql,blkd->bqkgd", p.astype(self.dtype), vals)
+        return out.reshape(B, T, H, hd)
 
     def _cached_attend(self, q, k, v):
         """Write this call's K/V at the cache cursor, attend q over the
@@ -283,17 +383,8 @@ class CausalSelfAttention(nn.Module):
             )
 
         if quant:
-            def quantize(x):
-                a = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
-                s = jnp.maximum(a / 127.0, 1e-8)
-                qx = jnp.clip(
-                    jnp.round(x.astype(jnp.float32) / s[..., None]),
-                    -127, 127,
-                ).astype(jnp.int8)
-                return qx, s
-
-            kq, k_s = quantize(k)
-            vq, v_s = quantize(v)
+            kq, k_s = _quantize_int8(k)
+            vq, v_s = _quantize_int8(v)
             ck.value = put(ck.value, kq)
             cv.value = put(cv.value, vq)
             ks.value = put(ks.value, k_s)
@@ -325,7 +416,7 @@ class CausalSelfAttention(nn.Module):
         return out.reshape(B, T, H, hd)
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, block_tables=None, seq_lens=None):
         B, T, D = x.shape
         H = self.num_heads
         hd = D // H
@@ -345,6 +436,27 @@ class CausalSelfAttention(nn.Module):
                 "slot_cursor=True (per-row cache cursors) only makes "
                 "sense with decode=True"
             )
+        if self.paged:
+            if not self.decode:
+                raise ValueError(
+                    "paged=True (block-pooled KV cache) requires "
+                    "decode=True"
+                )
+            if self.slot_cursor:
+                raise ValueError(
+                    "paged and slot_cursor are mutually exclusive cache "
+                    "layouts"
+                )
+            if self.num_pages < 2:
+                raise ValueError(
+                    f"paged mode needs num_pages >= 2 (block 0 is the "
+                    f"reserved trash block); got {self.num_pages}"
+                )
+            if block_tables is None or seq_lens is None:
+                raise ValueError(
+                    "paged mode needs block_tables [B, max_blocks] and "
+                    "seq_lens [B] passed per call"
+                )
         Hk = self.num_kv_heads or H
         if H % Hk != 0:
             raise ValueError(
@@ -393,7 +505,10 @@ class CausalSelfAttention(nn.Module):
                 )
             if self.cache_len <= 0:
                 raise ValueError("decode mode needs cache_len > 0")
-            out = self._cached_attend(q, k, v)
+            if self.paged:
+                out = self._paged_attend(q, k, v, block_tables, seq_lens)
+            else:
+                out = self._cached_attend(q, k, v)
             return TPDenseGeneral(
                 features=(D,), in_axes=2, mode="row",
                 tp_size=self.tp_size, tp_axis=self.tp_axis,
@@ -486,9 +601,12 @@ class Block(nn.Module):
     num_kv_heads: Optional[int] = None  # GQA; None = MHA
     cache_dtype: str = "model"  # decode KV cache: 'model' | 'int8'
     slot_cursor: bool = False  # per-row cache cursors (serving engine)
+    paged: bool = False  # block-pooled KV cache (serving/kvpool.py)
+    page_block_size: int = 16
+    num_pages: int = 0
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, block_tables=None, seq_lens=None):
         D = x.shape[-1]
         h = nn.LayerNorm(dtype=self.dtype)(x)
         x = x + CausalSelfAttention(
@@ -498,7 +616,10 @@ class Block(nn.Module):
             num_kv_heads=self.num_kv_heads,
             cache_dtype=self.cache_dtype,
             slot_cursor=self.slot_cursor,
-        )(h)
+            paged=self.paged,
+            page_block_size=self.page_block_size,
+            num_pages=self.num_pages,
+        )(h, block_tables, seq_lens)
         h = nn.LayerNorm(dtype=self.dtype)(x)
         if self.moe_experts > 0:
             from distkeras_tpu.ops.moe import SwitchMoE
@@ -582,6 +703,17 @@ class TransformerLM(nn.Module):
     # own cursor — prefills scatter into a slot, EOS'd slots refill
     # without touching neighbours. decode=True only.
     slot_cursor: bool = False
+    # paged KV cache (serving/kvpool.py + serving/prefix.py): per-layer
+    # caches become one pool of num_pages fixed-size token blocks
+    # [num_pages, page_block_size, Hk, hd] shared by every sequence.
+    # Each apply() carries block_tables [B, max_blocks] (physical block
+    # ids per row) and seq_lens [B] (host-owned cursors); blocks holding
+    # a shared prompt prefix appear in many tables at once, which is
+    # what lets the radix prefix index skip their prefill entirely.
+    # decode=True only; exclusive with slot_cursor.
+    paged: bool = False
+    page_block_size: int = 16
+    num_pages: int = 0
     # features_only=True returns the backbone's ln_f output [B, T, D]
     # instead of logits, for the fused chunked cross-entropy
     # (ops/fused_ce.py): the head matmul then happens INSIDE the loss,
@@ -592,7 +724,8 @@ class TransformerLM(nn.Module):
     features_only: bool = False
 
     @nn.compact
-    def __call__(self, tokens, train: bool = False):
+    def __call__(self, tokens, train: bool = False,
+                 block_tables=None, seq_lens=None):
         if self.remat not in ("none", "block"):
             raise ValueError(
                 f"Unknown remat policy '{self.remat}'. Known: none, block"
@@ -605,6 +738,10 @@ class TransformerLM(nn.Module):
             raise ValueError(
                 "slot_cursor=True (per-row cache cursors) requires "
                 "decode=True"
+            )
+        if self.paged and not self.decode:
+            raise ValueError(
+                "paged=True (block-pooled KV cache) requires decode=True"
             )
         rope = self.pos_emb == "rope"
         # explicit submodule names: the pipeline-parallel path addresses
@@ -625,21 +762,29 @@ class TransformerLM(nn.Module):
                 offset = jax.lax.axis_index(self.seq_axis) * x.shape[1]
                 local_pos = local_pos + offset
             if self.decode:
-                # decode steps see only the new tokens; their positions
-                # start at the running cursor (kept with the KV caches) —
-                # a scalar, or one cursor per slot under slot_cursor
-                pos_idx = self.variable(
-                    "cache", "pos_index",
-                    lambda: jnp.zeros(
-                        (x.shape[0],) if self.slot_cursor else (),
-                        jnp.int32,
-                    ),
-                )
-                if self.slot_cursor:
-                    local_pos = local_pos[None, :] + pos_idx.value[:, None]
+                if self.paged:
+                    # paged cursors are host-owned and arrive per call:
+                    # positions start at each row's seq_lens entry (no
+                    # pos_index cache variable to keep in sync)
+                    local_pos = local_pos[None, :] + seq_lens[:, None]
                 else:
-                    local_pos = local_pos + pos_idx.value
-                pos_idx.value = pos_idx.value + x.shape[1]
+                    # decode steps see only the new tokens; their
+                    # positions start at the running cursor (kept with
+                    # the KV caches) — a scalar, or one cursor per slot
+                    # under slot_cursor
+                    pos_idx = self.variable(
+                        "cache", "pos_index",
+                        lambda: jnp.zeros(
+                            (x.shape[0],) if self.slot_cursor else (),
+                            jnp.int32,
+                        ),
+                    )
+                    if self.slot_cursor:
+                        local_pos = (local_pos[None, :]
+                                     + pos_idx.value[:, None])
+                    else:
+                        local_pos = local_pos + pos_idx.value
+                    pos_idx.value = pos_idx.value + x.shape[1]
             taken = jnp.take(pos_table, local_pos, axis=0)
             if taken.ndim == 2:  # shared positions: broadcast over batch
                 taken = taken[None]
@@ -665,8 +810,11 @@ class TransformerLM(nn.Module):
                 num_kv_heads=self.num_kv_heads,
                 cache_dtype=self.cache_dtype,
                 slot_cursor=self.slot_cursor,
+                paged=self.paged,
+                page_block_size=self.page_block_size,
+                num_pages=self.num_pages,
                 name=f"Block_{i}",
-            )(x)
+            )(x, block_tables, seq_lens)
         x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
         if self.features_only:
             return x

@@ -6,10 +6,22 @@ request throughput: a fixed pool of KV-cache slots advanced by one jitted
 decode step per tick (:mod:`engine`), an admission queue with
 backpressure and deadlines (:mod:`scheduler`), and a TCP front-end that
 streams tokens per request over the framed-msgpack transport
-(:mod:`server`).
+(:mod:`server`). With ``ServingEngine(paged=True)`` the slot slabs
+become a pool of fixed-size KV blocks (:mod:`kvpool`) with radix-tree
+prompt-prefix sharing (:mod:`prefix`): repeated system prompts are
+prefilled once and reference-counted, with copy-on-write at mid-block
+divergence and LRU eviction of unreferenced cached blocks.
 """
 
 from distkeras_tpu.serving.engine import ServingEngine  # noqa: F401
+from distkeras_tpu.serving.kvpool import (  # noqa: F401
+    BlockPool,
+    OutOfBlocksError,
+)
+from distkeras_tpu.serving.prefix import (  # noqa: F401
+    PrefixMatch,
+    RadixPrefixIndex,
+)
 from distkeras_tpu.serving.scheduler import (  # noqa: F401
     FIFOScheduler,
     QueueFullError,
@@ -23,6 +35,10 @@ from distkeras_tpu.serving.server import (  # noqa: F401
 
 __all__ = [
     "ServingEngine",
+    "BlockPool",
+    "OutOfBlocksError",
+    "PrefixMatch",
+    "RadixPrefixIndex",
     "FIFOScheduler",
     "QueueFullError",
     "Request",

@@ -24,6 +24,15 @@ row. This engine removes both taxes while keeping every shape static
   token budget) is freed when the tick's tokens are processed and
   refilled from the scheduler queue in the same :meth:`step` call — the
   next tick already decodes the new request.
+- **Paged mode** (``paged=True``): the per-slot slabs become one pool of
+  fixed-size KV blocks (:mod:`distkeras_tpu.serving.kvpool`) addressed
+  through per-row block tables, with radix-tree prompt-prefix sharing
+  (:mod:`distkeras_tpu.serving.prefix`) — a request whose prompt opens
+  with an already-cached prefix increfs those blocks and prefills only
+  the suffix (copy-on-write when it diverges mid-block). Admission
+  becomes free-block-aware so live sequences are never evicted
+  mid-decode. Token streams remain bit-identical to solo ``generate()``
+  in both modes.
 
 Observability is the :mod:`distkeras_tpu.telemetry` layer: every request
 leaves a span chain (``queued → prefill → decode → finish``, with slot
@@ -52,6 +61,8 @@ import numpy as np
 
 from distkeras_tpu import telemetry
 from distkeras_tpu.models.transformer import sample_tokens
+from distkeras_tpu.serving.kvpool import BlockPool
+from distkeras_tpu.serving.prefix import RadixPrefixIndex
 from distkeras_tpu.serving.scheduler import FIFOScheduler, Request
 from distkeras_tpu.utils.metrics import MetricsWriter
 
@@ -123,6 +134,65 @@ def _tick_fn(dm_slot, cfgs):
     return tick
 
 
+@functools.lru_cache(maxsize=64)
+def _paged_prefill_fn(dm_paged):
+    """Compiled paged prefill: run the prompt's UNCACHED suffix at B=1
+    against the shared block pool — the row's block table maps each
+    suffix position into blocks this row owns, and cached prefix
+    positions are simply attended (their K/V was written by whichever
+    request computed them first). The cache IS the global pool, so
+    unlike the slot path there is no per-slot scatter-merge step."""
+
+    @jax.jit
+    def prefill(params_only, cache, last_logits, suffix, table, start,
+                slot):
+        logits, vs = dm_paged.apply(
+            {**params_only, "cache": cache}, suffix,
+            block_tables=table, seq_lens=start, mutable=["cache"],
+        )
+        new_last = last_logits.at[slot].set(
+            logits[0, -1].astype(last_logits.dtype)
+        )
+        return vs["cache"], new_last
+
+    return prefill
+
+
+@functools.lru_cache(maxsize=256)
+def _paged_tick_fn(dm_paged, cfgs):
+    """Paged twin of :func:`_tick_fn`: identical per-slot sampling (same
+    RNG chains, same [1, vocab] call shape), then one decode step whose
+    K/V reads/writes go through each row's block table."""
+
+    @jax.jit
+    def tick(params_only, cache, last_logits, rngs, tables, lens):
+        toks, new_rngs = [], []
+        for s, (temp, top_k, top_p) in enumerate(cfgs):
+            rng, sub = jax.random.split(rngs[s])
+            toks.append(
+                sample_tokens(last_logits[s][None], sub, temp,
+                              top_k, top_p)[0]
+            )
+            new_rngs.append(rng)
+        tok = jnp.stack(toks)  # [S]
+        logits, vs = dm_paged.apply(
+            {**params_only, "cache": cache}, tok[:, None],
+            block_tables=tables, seq_lens=lens, mutable=["cache"],
+        )
+        return vs["cache"], logits[:, -1], tok, jnp.stack(new_rngs)
+
+    return tick
+
+
+@jax.jit
+def _copy_block(cache, src, dst):
+    """Copy-on-write: duplicate physical block ``src`` into ``dst``
+    across every paged cache leaf (K, V, int8 scales — all block-major),
+    so a sequence that diverges mid-block writes into its own copy and
+    the shared original stays immutable."""
+    return jax.tree.map(lambda c: c.at[dst].set(c[src]), cache)
+
+
 _IDLE_CFG = (0.0, None, None)  # free slots sample greedily into the void
 
 
@@ -130,6 +200,8 @@ _IDLE_CFG = (0.0, None, None)  # free slots sample greedily into the void
 class _SlotState:
     req: Request
     remaining: int
+    blocks: Optional[List[int]] = None  # paged: this row's block chain
+    cached_tokens: int = 0  # paged: prompt tokens served from the index
 
 
 class ServingEngine:
@@ -155,6 +227,20 @@ class ServingEngine:
         per-request span chain; defaults to the process-global one. The
         scheduler (given or created) is adopted into the same pair so
         trace ids and queue metrics stay coherent.
+      paged: replace the contiguous ``[S, max_len, ...]`` slabs with a
+        pool of fixed-size KV blocks (``[num_blocks, block_size, ...]``
+        per layer) plus per-row block tables — memory committed as
+        sequences grow, prompt prefixes shared across requests through
+        the radix index (prefill skipped for the shared span,
+        copy-on-write at mid-block divergence), and LRU eviction of
+        unreferenced cached blocks. Token streams remain bit-identical
+        to solo ``generate()`` (tests/test_paged.py parity matrix).
+      block_size: tokens per KV block; ``max_len`` must be a multiple.
+      num_blocks: physical blocks in the pool (one is the reserved
+        trash block). Defaults to worst-case-per-slot + 1; raise it for
+        prefix-cache headroom.
+      prefix_cache: set False to disable radix prefix sharing (every
+        prompt fully prefills; blocks free immediately at finish).
 
     Drive it with :meth:`step` (one admit→tick→complete→refill cycle,
     e.g. from a test) or :meth:`serve_forever` (the TCP front-end's
@@ -167,12 +253,16 @@ class ServingEngine:
                  scheduler: Optional[FIFOScheduler] = None,
                  metrics: Optional[MetricsWriter] = None,
                  registry: Optional[telemetry.MetricRegistry] = None,
-                 tracer: Optional[telemetry.Tracer] = None):
+                 tracer: Optional[telemetry.Tracer] = None,
+                 paged: bool = False, block_size: int = 16,
+                 num_blocks: Optional[int] = None,
+                 prefix_cache: bool = True):
         if slots < 1:
             raise ValueError(f"slots must be >= 1; got {slots}")
         self.model = (model if max_len is None
                       else model.clone(max_len=max_len, parent=None))
         self.slots = slots
+        self.paged = paged
         self.registry = registry or telemetry.get_registry()
         self.tracer = tracer or telemetry.get_tracer()
         self.scheduler = scheduler or FIFOScheduler(
@@ -185,18 +275,64 @@ class ServingEngine:
         self.scheduler._wire_metrics()
         self._wire_metrics()
         self.metrics = metrics or MetricsWriter()
-        self._dm_slot = self.model.clone(
-            decode=True, slot_cursor=True, parent=None
-        )
-        self._dm_one = self.model.clone(decode=True, parent=None)
         self._params_only = {"params": params["params"]}
-        self._cache = jax.tree.map(
-            lambda s: jnp.zeros(s.shape, s.dtype),
-            jax.eval_shape(
-                self._dm_slot.init, jax.random.PRNGKey(0),
-                jnp.zeros((slots, 1), jnp.int32),
-            )["cache"],
-        )
+        if paged:
+            if self.model.max_len % block_size != 0:
+                raise ValueError(
+                    f"max_len={self.model.max_len} must be a multiple of "
+                    f"block_size={block_size}: the gathered per-row view "
+                    f"must equal the contiguous cache length exactly "
+                    f"(that equality is the bit-parity guarantee)"
+                )
+            self.block_size = block_size
+            self._max_blocks = self.model.max_len // block_size
+            if num_blocks is None:
+                # worst case every slot at max_len, plus the trash block;
+                # raise num_blocks for prefix-cache headroom beyond what
+                # finished requests leave behind
+                num_blocks = BlockPool.RESERVED + slots * self._max_blocks
+            self.pool = BlockPool(num_blocks, block_size,
+                                  registry=self.registry)
+            self.prefix = (RadixPrefixIndex(block_size)
+                           if prefix_cache else None)
+            self._dm_paged = self.model.clone(
+                decode=True, paged=True, page_block_size=block_size,
+                num_pages=num_blocks, parent=None,
+            )
+            self._cache = jax.tree.map(
+                lambda s: jnp.zeros(s.shape, s.dtype),
+                jax.eval_shape(
+                    # keywords: init's positional slot after tokens is
+                    # `train`, not block_tables
+                    lambda r, t, bt, sl: self._dm_paged.init(
+                        r, t, block_tables=bt, seq_lens=sl
+                    ),
+                    jax.random.PRNGKey(0),
+                    jnp.zeros((1, 1), jnp.int32),
+                    jnp.zeros((1, self._max_blocks), jnp.int32),
+                    jnp.zeros((1,), jnp.int32),
+                )["cache"],
+            )
+            # host-owned per-row state fed to every jitted call; idle
+            # rows point at the reserved trash block at length 0
+            self._block_tables = np.zeros(
+                (slots, self._max_blocks), np.int32
+            )
+            self._seq_lens = np.zeros((slots,), np.int32)
+        else:
+            self.pool = None
+            self.prefix = None
+            self._dm_slot = self.model.clone(
+                decode=True, slot_cursor=True, parent=None
+            )
+            self._dm_one = self.model.clone(decode=True, parent=None)
+            self._cache = jax.tree.map(
+                lambda s: jnp.zeros(s.shape, s.dtype),
+                jax.eval_shape(
+                    self._dm_slot.init, jax.random.PRNGKey(0),
+                    jnp.zeros((slots, 1), jnp.int32),
+                )["cache"],
+            )
         self._last_logits = jnp.zeros(
             (slots, self.model.vocab_size), jnp.float32
         )
@@ -207,6 +343,8 @@ class ServingEngine:
         self.ticks = 0
         self.requests_completed = 0
         self.tokens_generated = 0
+        self.prompt_tokens = 0
+        self.prefix_hit_tokens = 0
         self._occ_sum = 0
 
     def _wire_metrics(self):
@@ -236,6 +374,13 @@ class ServingEngine:
         self._m_decode_tps = reg.gauge(
             "serving_decode_tokens_per_sec",
             "tokens emitted by the latest tick over its wall time")
+        self._m_prefix_hit = reg.counter(
+            "serving_prefix_hit_tokens_total",
+            "prompt tokens served from the radix prefix cache "
+            "(prefill skipped)")
+        self._m_prompt_tokens = reg.counter(
+            "serving_prompt_tokens_total",
+            "prompt tokens across admitted requests (hit + prefilled)")
 
     # -- submission ---------------------------------------------------------
 
@@ -317,28 +462,86 @@ class ServingEngine:
         free = [i for i, st in enumerate(self._slots) if st is None]
         if not free:
             return 0
-        admitted, expired = self.scheduler.pop_admissible(len(free))
+        admissible = None
+        if self.paged:
+            # free-block-aware admission: a request only enters a slot
+            # when its WORST-CASE block need (full prompt + full token
+            # budget, minus prefix blocks pinned by live refs) fits in
+            # free + evictable blocks. Without this, a large admission
+            # could force mid-decode eviction of blocks a live sequence
+            # still needs — admission is the only safe place to say no.
+            # `reserved` accumulates within one pop so a batch of
+            # admissions can't jointly overcommit.
+            reserved = [0]
+
+            def admissible(req: Request) -> bool:
+                need, avail = self._paged_headroom(req)
+                if avail - reserved[0] < need:
+                    return False
+                reserved[0] += need
+                return True
+
+        admitted, expired = self.scheduler.pop_admissible(
+            len(free), admissible=admissible
+        )
         for req in expired:
-            req.done_t = time.monotonic()
-            queued_ms = (req.done_t - req.submit_t) * 1e3
-            self.tracer.record(req.trace_id, "queued", req.submit_t,
-                               queued_ms)
-            self.tracer.record(req.trace_id, "finish", req.done_t, 0.0,
-                               reason="expired", tokens=0)
-            self._m_requests.labels(reason="expired").inc()
-            req.stream._finish("expired")
+            # span chain, finish-reason counter, and the stream sentinel
+            # are recorded by the scheduler (expiry is visible in trace
+            # dumps even if no engine ever pops); the engine adds only
+            # its per-request JSONL summary
             self.metrics.summary(
                 "request", rid=req.rid, reason="expired", tokens=0,
-                queued_ms=round(queued_ms, 3),
+                queued_ms=round((req.done_t - req.submit_t) * 1e3, 3),
             )
         for req in admitted:
             self._prefill_into(free.pop(0), req)
         return len(admitted)
 
+    # -- paged internals ----------------------------------------------------
+
+    def _blocks_for(self, req: Request) -> int:
+        """Worst-case logical blocks a request can occupy: every prompt
+        and generated token position, rounded up to whole blocks."""
+        return -(-(int(req.prompt.size) + req.max_new_tokens)
+                 // self.block_size)
+
+    def _paged_headroom(self, req: Request):
+        """(need, avail) for admission: fresh blocks the request must be
+        able to allocate (prefix hits only count as savings while their
+        blocks are pinned by live references — an unreferenced cached
+        block could be evicted by a peer admission before this request
+        reaches it), and the blocks obtainable without touching live
+        data (free + unreferenced cached, excluding this request's own
+        hit chain)."""
+        total = self._blocks_for(req)
+        if self.prefix is None:
+            return total, self.pool.free_count()
+        m = self.prefix.match(req.prompt)
+        hit_live = sum(1 for b in m.blocks if self.pool.ref[b] > 0)
+        avail = self.pool.free_count() + self.prefix.evictable_count(
+            self.pool.ref, exclude=m.blocks
+        )
+        return total - hit_live, avail
+
+    def _alloc_blocks(self, n: int, keep=()) -> List[int]:
+        """Allocate ``n`` blocks, evicting LRU unreferenced prefix
+        blocks as needed (``keep`` protects a hit chain about to be
+        reused). Admission guarantees this succeeds for admitted
+        requests; OutOfBlocksError here means admission was bypassed."""
+        while self.pool.free_count() < n and self.prefix is not None:
+            blk = self.prefix.evict_lru(self.pool.ref, exclude=keep)
+            if blk is None:
+                break
+            self.pool.evict(blk)
+        return self.pool.alloc(n)
+
     def _prefill_into(self, slot: int, req: Request):
         now = time.monotonic()
         self.tracer.record(req.trace_id, "queued", req.submit_t,
                            (now - req.submit_t) * 1e3)
+        if self.paged:
+            self._paged_prefill_into(slot, req, now)
+            return
         prefill = _prefill_fn(self._dm_one)
         prompt = jnp.asarray(req.prompt, jnp.int32)[None]
         t0 = time.perf_counter()
@@ -349,6 +552,8 @@ class ServingEngine:
         self._rngs = self._rngs.at[slot].set(jax.random.PRNGKey(req.seed))
         self._slots[slot] = _SlotState(req=req,
                                        remaining=req.max_new_tokens)
+        self.prompt_tokens += int(req.prompt.size)
+        self._m_prompt_tokens.inc(int(req.prompt.size))
         # dispatch time only — no forced sync here; the tick's own
         # host fetch is the hot path's one synchronization point
         prefill_ms = (time.perf_counter() - t0) * 1e3
@@ -357,17 +562,96 @@ class ServingEngine:
                            slot=slot, prompt_tokens=int(req.prompt.size))
         self._m_prefill_ms.observe(prefill_ms)
 
+    def _paged_prefill_into(self, slot: int, req: Request, now: float):
+        """Admit one request into a paged slot: reuse the radix-matched
+        prefix blocks (refcount bump, zero prefill), copy-on-write a
+        partially-shared block if the prompt diverges mid-block, then
+        prefill ONLY the uncached suffix at B=1 through the shared block
+        pool."""
+        bs = self.block_size
+        Tp = int(req.prompt.size)
+        m = self.prefix.match(req.prompt) if self.prefix else None
+        shared = list(m.blocks) if m else []
+        total = self._blocks_for(req)
+        # len(shared)*bs <= Tp-1 < total*bs, so at least one fresh block
+        fresh = self._alloc_blocks(total - len(shared), keep=shared)
+        chain = shared + fresh
+        self.pool.incref(chain)
+        cached = len(shared) * bs
+        if m is not None and m.cow is not None:
+            # the prompt shares j tokens of a cached block, then
+            # diverges: copy that block into this row's first fresh
+            # block — the row's writes land in its own copy, the shared
+            # original stays immutable under other tables
+            src, j = m.cow
+            self._cache = _copy_block(
+                self._cache, jnp.int32(src), jnp.int32(fresh[0])
+            )
+            cached += j
+        suffix = jnp.asarray(req.prompt[cached:], jnp.int32)[None]
+        table = np.zeros((1, self._max_blocks), np.int32)
+        table[0, :len(chain)] = chain
+        prefill = _paged_prefill_fn(self._dm_paged)
+        t0 = time.perf_counter()
+        self._cache, self._last_logits = prefill(
+            self._params_only, self._cache, self._last_logits,
+            suffix, jnp.asarray(table),
+            jnp.asarray([cached], jnp.int32), jnp.int32(slot),
+        )
+        self._rngs = self._rngs.at[slot].set(jax.random.PRNGKey(req.seed))
+        # copy-and-rebind (never mutate in place): the previous tick's
+        # jnp.asarray of these buffers may still alias them on-device
+        tables = self._block_tables.copy()
+        tables[slot, :] = 0
+        tables[slot, :len(chain)] = chain
+        self._block_tables = tables
+        lens = self._seq_lens.copy()
+        lens[slot] = Tp
+        self._seq_lens = lens
+        self._slots[slot] = _SlotState(
+            req=req, remaining=req.max_new_tokens, blocks=chain,
+            cached_tokens=cached,
+        )
+        self.prompt_tokens += Tp
+        self.prefix_hit_tokens += cached
+        self._m_prompt_tokens.inc(Tp)
+        self._m_prefix_hit.inc(cached)
+        prefill_ms = (time.perf_counter() - t0) * 1e3
+        req.prefill_done_t = time.monotonic()
+        self.tracer.record(req.trace_id, "prefill", now, prefill_ms,
+                           slot=slot, prompt_tokens=Tp,
+                           cached_tokens=cached, blocks=len(chain))
+        self._m_prefill_ms.observe(prefill_ms)
+
     def _decode_tick(self):
         cfgs = tuple(
             (st.req.temperature, st.req.top_k, st.req.top_p)
             if st else _IDLE_CFG
             for st in self._slots
         )
-        tick = _tick_fn(self._dm_slot, cfgs)
         t0 = time.perf_counter()
-        self._cache, self._last_logits, toks, self._rngs = tick(
-            self._params_only, self._cache, self._last_logits, self._rngs
-        )
+        if self.paged:
+            tick = _paged_tick_fn(self._dm_paged, cfgs)
+            self._cache, self._last_logits, toks, self._rngs = tick(
+                self._params_only, self._cache, self._last_logits,
+                self._rngs, jnp.asarray(self._block_tables),
+                jnp.asarray(self._seq_lens),
+            )
+            # the tick wrote each live row's K/V at its cursor; advance
+            # the host-owned cursors (idle rows stay parked at 0 on the
+            # trash block). REBIND, never mutate: jnp.asarray can alias
+            # the numpy buffer zero-copy while the async tick still
+            # reads it — in-place writes would race the device
+            alive = np.fromiter(
+                (st is not None for st in self._slots), bool, self.slots
+            )
+            self._seq_lens = self._seq_lens + alive.astype(np.int32)
+        else:
+            tick = _tick_fn(self._dm_slot, cfgs)
+            self._cache, self._last_logits, toks, self._rngs = tick(
+                self._params_only, self._cache, self._last_logits,
+                self._rngs
+            )
         toks_host = np.asarray(toks)  # forces completion of the tick
         tick_ms = (time.perf_counter() - t0) * 1e3
         self.ticks += 1
@@ -427,6 +711,15 @@ class ServingEngine:
         )
         self._m_requests.labels(reason=reason).inc()
         req.stream._finish(reason)
+        if self.paged:
+            self._release_blocks(st)
+            # copy-and-rebind: park the freed row on the trash block
+            tables = self._block_tables.copy()
+            tables[slot, :] = 0
+            self._block_tables = tables
+            lens = self._seq_lens.copy()
+            lens[slot] = 0
+            self._seq_lens = lens
         self._slots[slot] = None
         self.requests_completed += 1
         self.metrics.summary(
@@ -435,6 +728,29 @@ class ServingEngine:
             total_ms=round((req.done_t - req.submit_t) * 1e3, 3),
         )
 
+    def _release_blocks(self, st: _SlotState):
+        """Finish-time block bookkeeping: register the prompt's full
+        blocks in the radix index (future requests hit them), then drop
+        this request's references. Blocks at refcount zero stay
+        allocated if the index registers them (prefix cache, LRU
+        evictable); private blocks — generated tokens, partial prompt
+        tails, COW copies past the prompt — go straight back to the
+        free list."""
+        req = st.req
+        if self.prefix is not None:
+            n_full = int(req.prompt.size) // self.block_size
+            self.prefix.insert(
+                req.prompt[:n_full * self.block_size],
+                st.blocks[:n_full],
+            )
+        released = self.pool.decref(st.blocks)
+        to_free = [
+            b for b in released
+            if self.prefix is None or not self.prefix.contains_block(b)
+        ]
+        if to_free:
+            self.pool.free(to_free)
+
     # -- observability ------------------------------------------------------
 
     def stats(self) -> dict:
@@ -442,7 +758,7 @@ class ServingEngine:
         THIS engine. The process-cumulative view (histograms, labeled
         series) is ``self.registry.collect()`` — served by the TCP
         ``metrics`` op and the HTTP endpoint."""
-        return {
+        out = {
             "ticks": self.ticks,
             "requests_completed": self.requests_completed,
             "tokens_generated": self.tokens_generated,
@@ -453,3 +769,15 @@ class ServingEngine:
             "ttft_ms": self.metrics.percentiles("ttft_ms"),
             "token_ms": self.metrics.percentiles("token_ms"),
         }
+        if self.paged:
+            out.update({
+                "blocks_in_use": self.pool.in_use_count(),
+                "blocks_free": self.pool.free_count(),
+                "prompt_tokens": self.prompt_tokens,
+                "prefix_hit_tokens": self.prefix_hit_tokens,
+                "prefix_hit_fraction": (
+                    round(self.prefix_hit_tokens / self.prompt_tokens, 4)
+                    if self.prompt_tokens else 0.0
+                ),
+            })
+        return out

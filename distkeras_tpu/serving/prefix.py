@@ -1,0 +1,197 @@
+"""Radix-tree prefix index for the paged KV cache (RadixAttention-style
+prefix sharing, Zheng et al., SGLang 2024).
+
+Thousands of serving requests open with the same system prompt; the
+slot engine re-prefills that prefix for every one of them. With the
+cache paged (:mod:`distkeras_tpu.serving.kvpool`), a prefix's K/V lives
+in ordinary physical blocks — so a new request whose prompt starts with
+an already-computed prefix can point its block table at those blocks,
+bump their refcounts, and prefill only the uncached suffix.
+
+The index is a radix tree at **block granularity**: each node owns one
+physical block and is keyed by the exact ``block_size`` token ids that
+block covers, so a path from the root spells out a prefix in
+``block_size``-token steps. Rope positions are absolute, which is what
+makes a cached block reusable at all: the K/V for tokens ``[i*bs,
+(i+1)*bs)`` depends only on the token ids before and inside the block,
+never on what comes after.
+
+- **match(tokens)** walks exact-key children chunk by chunk (each match
+  = ``block_size`` prefill tokens skipped). Where the walk stops, it
+  scans the frontier children for the longest shared *partial* prefix:
+  a sequence that diverges mid-block can still reuse those ``j`` tokens
+  via **copy-on-write** — the engine copies the cached block into a
+  fresh one the new sequence owns, so its own writes never touch the
+  shared original. The hit is capped at ``len(tokens) - 1``: the last
+  prompt token is always prefilled, because sampling needs its logits.
+- **insert(tokens, blocks)** registers a finished request's full prompt
+  blocks. Chunks already present are skipped (two concurrent misses on
+  the same prompt converge on the first finisher's blocks; the
+  duplicate's go back to the pool at decref).
+- **evict_lru(ref)** pops the least-recently-matched *leaf* whose block
+  is unreferenced. Referenced nodes are never touched, and interior
+  nodes only become evictable after their subtree drains — an ancestor
+  is always at least as recently used and at least as referenced as its
+  descendants (every match touches/refs the whole path), so leaf-first
+  LRU never strands a child whose prefix context is gone.
+
+Engine-thread only, like the pool: no locks, deterministic behavior.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+
+@dataclass
+class PrefixMatch:
+    """Result of a lookup: ``blocks`` are fully-shared physical blocks
+    in prefix order; ``cow`` is an optional ``(source_block, tokens)``
+    partial hit at the divergence frontier — reusable only via
+    copy-on-write."""
+
+    blocks: List[int] = field(default_factory=list)
+    cow: Optional[Tuple[int, int]] = None
+    block_size: int = 0
+
+    @property
+    def hit_tokens(self) -> int:
+        return (len(self.blocks) * self.block_size
+                + (self.cow[1] if self.cow else 0))
+
+
+class _Node:
+    __slots__ = ("key", "block", "children", "parent", "last_access")
+
+    def __init__(self, key: Tuple[int, ...], block: Optional[int],
+                 parent: Optional["_Node"]):
+        self.key = key
+        self.block = block
+        self.parent = parent
+        self.children: Dict[Tuple[int, ...], "_Node"] = {}
+        self.last_access = 0
+
+
+class RadixPrefixIndex:
+    """Token-prefix → block-chain index at ``block_size`` granularity."""
+
+    def __init__(self, block_size: int):
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1; got {block_size}")
+        self.block_size = block_size
+        self._root = _Node((), None, None)
+        self._by_block: Dict[int, _Node] = {}
+        self._clock = 0  # logical LRU time: bumped per match/insert
+
+    def __len__(self) -> int:
+        return len(self._by_block)
+
+    def contains_block(self, block: int) -> bool:
+        return block in self._by_block
+
+    def _tick(self) -> int:
+        self._clock += 1
+        return self._clock
+
+    # -- lookup -------------------------------------------------------------
+
+    def match(self, tokens) -> PrefixMatch:
+        """Longest cached prefix of ``tokens``, capped at
+        ``len(tokens) - 1`` so at least one token remains to prefill
+        (its logits seed sampling). Touches every node on the matched
+        path (LRU recency)."""
+        toks = tuple(int(t) for t in tokens)
+        bs = self.block_size
+        limit = len(toks) - 1  # the final prompt token is never skipped
+        now = self._tick()
+        node = self._root
+        blocks: List[int] = []
+        h = 0
+        while h + bs <= limit:
+            child = node.children.get(toks[h:h + bs])
+            if child is None:
+                break
+            child.last_access = now
+            blocks.append(child.block)
+            node = child
+            h += bs
+        cow = None
+        rest = toks[h:limit]
+        if rest:
+            best_j, best = 0, None
+            for key, child in node.children.items():
+                j = 0
+                for a, b in zip(key, rest):
+                    if a != b:
+                        break
+                    j += 1
+                if j > best_j:
+                    best_j, best = j, child
+            if best is not None:
+                best.last_access = now
+                cow = (best.block, best_j)
+        return PrefixMatch(blocks=blocks, cow=cow, block_size=bs)
+
+    # -- registration -------------------------------------------------------
+
+    def insert(self, tokens, blocks) -> List[int]:
+        """Register a prompt's full-block chain: chunk ``i`` of
+        ``tokens`` (``block_size`` ids) is served by physical block
+        ``blocks[i]``. Trailing tokens past the last full block are
+        ignored (a partial block is private to its sequence — its tail
+        slots will be overwritten by decode writes). Returns the block
+        ids actually registered (already-present chunks are skipped —
+        their existing node wins, and the caller's duplicate block stays
+        unregistered so decref frees it)."""
+        toks = tuple(int(t) for t in tokens)
+        bs = self.block_size
+        n_full = min(len(toks) // bs, len(blocks))
+        now = self._tick()
+        node = self._root
+        registered: List[int] = []
+        for i in range(n_full):
+            key = toks[i * bs:(i + 1) * bs]
+            child = node.children.get(key)
+            if child is None:
+                b = int(blocks[i])
+                if b in self._by_block:
+                    raise ValueError(
+                        f"block {b} already registered to another prefix"
+                    )
+                child = _Node(key, b, node)
+                node.children[key] = child
+                self._by_block[b] = child
+                registered.append(b)
+            child.last_access = now
+            node = child
+        return registered
+
+    # -- eviction -----------------------------------------------------------
+
+    def evictable_count(self, ref, exclude=()) -> int:
+        """How many registered blocks an allocator could reclaim:
+        unreferenced (``ref[b] == 0``) and not in ``exclude`` (e.g. the
+        hit chain an admission check is about to reuse). Refcounts are
+        monotone down the tree (every match refs its whole path), so all
+        of these are reachable by repeated leaf eviction."""
+        ex = set(exclude)
+        return sum(1 for b in self._by_block
+                   if ref[b] == 0 and b not in ex)
+
+    def evict_lru(self, ref, exclude=()) -> Optional[int]:
+        """Unlink and return the least-recently-matched unreferenced
+        leaf's block (caller frees it via :meth:`BlockPool.evict`), or
+        None when nothing is evictable."""
+        ex = set(exclude)
+        best: Optional[_Node] = None
+        for b, node in self._by_block.items():
+            if node.children or ref[b] != 0 or b in ex:
+                continue
+            if best is None or node.last_access < best.last_access:
+                best = node
+        if best is None:
+            return None
+        del best.parent.children[best.key]
+        del self._by_block[best.block]
+        return best.block

@@ -21,7 +21,7 @@ import threading
 import time
 from collections import deque
 from dataclasses import dataclass, field
-from typing import List, Optional, Tuple
+from typing import Callable, List, Optional, Tuple
 
 import numpy as np
 
@@ -150,6 +150,12 @@ class FIFOScheduler:
             "serving_requests_rejected_total",
             "submissions refused by queue backpressure",
         )
+        # shared with the engine's finish-reason counter (get-or-create)
+        # so queued-deadline expiries land in the same series
+        self._m_finished = self.registry.counter(
+            "serving_requests_total",
+            "requests finished, by finish reason", labelnames=("reason",),
+        )
 
     def submit(self, req: Request) -> Request:
         """Enqueue or raise :class:`QueueFullError` (backpressure).
@@ -176,12 +182,20 @@ class FIFOScheduler:
             return len(self._q)
 
     def pop_admissible(
-        self, free_slots: int
+        self, free_slots: int,
+        admissible: Optional[Callable[[Request], bool]] = None,
     ) -> Tuple[List[Request], List[Request]]:
         """Pop up to ``min(free_slots, max_prefills_per_tick)`` requests
-        in FIFO order, dropping deadline-expired ones along the way.
-        Returns ``(admitted, expired)`` — the engine prefills the first
-        list and fails the second."""
+        in FIFO order, expiring deadline-passed ones along the way.
+        ``admissible`` is an optional resource gate (the paged engine's
+        free-block check): when the HEAD request fails it, popping stops
+        — FIFO order is preserved (no queue-jumping past a request that
+        is merely waiting for blocks), and the head retries next step.
+        Returns ``(admitted, expired)``; expired requests are already
+        finished here — span chain (``queued`` → ``finish`` with
+        ``reason="expired"``), finish-reason counter, and the stream's
+        end sentinel — so they show up in trace dumps even if the
+        caller drops them."""
         admitted: List[Request] = []
         expired: List[Request] = []
         budget = min(free_slots, self.max_prefills_per_tick)
@@ -193,8 +207,26 @@ class FIFOScheduler:
                         and now - req.submit_t > req.deadline_s):
                     expired.append(self._q.popleft())
                     continue
+                if admissible is not None and not admissible(req):
+                    break
                 admitted.append(self._q.popleft())
             depth = len(self._q)
+        for req in expired:
+            self._expire(req)
         if admitted or expired:
             self._m_depth.set(depth)
         return admitted, expired
+
+    def _expire(self, req: Request):
+        """Finish a queued request whose deadline passed before a slot
+        freed: full telemetry (the request must not vanish from trace
+        dumps just because it never reached the engine) and the stream
+        end sentinel consumers are blocked on."""
+        req.done_t = time.monotonic()
+        queued_ms = (req.done_t - req.submit_t) * 1e3
+        self.tracer.record(req.trace_id, "queued", req.submit_t,
+                           queued_ms)
+        self.tracer.record(req.trace_id, "finish", req.done_t, 0.0,
+                           reason="expired", tokens=0)
+        self._m_finished.labels(reason="expired").inc()
+        req.stream._finish("expired")

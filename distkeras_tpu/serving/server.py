@@ -219,9 +219,16 @@ class ServingClient:
     tokens. A reader thread demultiplexes tagged frames into per-request
     queues, so many requests can be in flight on one connection."""
 
-    def __init__(self, host: str, port: int, timeout: float = 60.0):
+    def __init__(self, host: str, port: int, timeout: float = 60.0,
+                 request_timeout: float = 60.0):
+        """``timeout`` bounds raw socket operations; ``request_timeout``
+        is the default wait for any reply — ack frames in :meth:`_call`
+        and per-token waits in :meth:`result` — inherited by every call
+        unless overridden per call. Expiries raise :class:`TimeoutError`
+        naming the operation/request."""
         self._sock = connect(host, port)
         self._sock.settimeout(timeout)
+        self.request_timeout = request_timeout
         self._send_lock = threading.Lock()
         self._acks: _queue.Queue = _queue.Queue()
         self._streams: Dict[int, _queue.Queue] = {}
@@ -261,10 +268,17 @@ class ServingClient:
                     q.put(("end", "connection closed"))
             self._acks.put({"ok": 0, "error": "connection closed"})
 
-    def _call(self, msg: dict, timeout: float = 60.0) -> dict:
+    def _call(self, msg: dict, timeout: Optional[float] = None) -> dict:
+        if timeout is None:
+            timeout = self.request_timeout
         with self._send_lock:
             send_msg(self._sock, msg)
-        reply = self._acks.get(timeout=timeout)
+        try:
+            reply = self._acks.get(timeout=timeout)
+        except _queue.Empty:
+            raise TimeoutError(
+                f"no reply to op {msg.get('op')!r} within {timeout}s"
+            ) from None
         if not reply.get("ok"):
             raise RuntimeError(reply.get("error", "request rejected"))
         return reply
@@ -293,13 +307,25 @@ class ServingClient:
                 return
             yield val
 
-    def result(self, rid: int,
-               timeout: float = 60.0) -> Tuple[List[int], Optional[str]]:
-        """Block until a request finishes: (tokens, finish_reason)."""
+    def result(self, rid: int, timeout: Optional[float] = None,
+               ) -> Tuple[List[int], Optional[str]]:
+        """Block until a request finishes: (tokens, finish_reason).
+        ``timeout`` bounds each inter-token wait (defaults to the
+        constructor's ``request_timeout``); a stalled stream raises
+        :class:`TimeoutError` naming the request instead of a bare
+        ``queue.Empty``."""
+        if timeout is None:
+            timeout = self.request_timeout
         q = self._stream_q(rid)
         out: List[int] = []
         while True:
-            kind, val = q.get(timeout=timeout)
+            try:
+                kind, val = q.get(timeout=timeout)
+            except _queue.Empty:
+                raise TimeoutError(
+                    f"request {rid}: no token or end-of-stream within "
+                    f"{timeout}s (received {len(out)} tokens)"
+                ) from None
             if kind == "end":
                 return out, val
             out.append(val)

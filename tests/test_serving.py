@@ -153,6 +153,62 @@ def test_queue_backpressure_and_deadline():
     assert r_dead.stream.finish_reason == "expired"
 
 
+def test_expired_request_leaves_finish_span():
+    """Satellite: a queued-deadline expiry is finished by the SCHEDULER
+    with a full span chain (queued → finish reason=expired), so expired
+    requests show in trace dumps instead of vanishing."""
+    from distkeras_tpu import telemetry
+
+    tracer = telemetry.Tracer()
+    model, params = _model_and_params()
+    eng = ServingEngine(model, params, slots=1, tracer=tracer,
+                        registry=telemetry.MetricRegistry())
+    p = np.zeros(4, np.int32)
+    r = eng.submit(p, max_new_tokens=2, deadline_s=0.0)
+    time.sleep(0.01)
+    eng.drain()
+    assert r.stream.tokens(timeout=10) == []
+    spans = {s["span"]: s for s in tracer.dump(trace=r.trace_id)}
+    assert set(spans) == {"queued", "finish"}
+    assert spans["finish"]["reason"] == "expired"
+    # and the finish-reason counter saw it
+    assert eng.registry.counter(
+        "serving_requests_total",
+        labelnames=("reason",)).labels(reason="expired").value == 1
+
+
+def test_client_request_timeout_names_request():
+    """Satellite: ServingClient's constructor-level request_timeout is
+    inherited by _call/result, and a stalled wait raises TimeoutError
+    naming the op/request instead of a bare queue.Empty."""
+    model, params = _model_and_params()
+    eng = ServingEngine(model, params, slots=1)
+    server = LMServer(eng).start()
+    try:
+        client = ServingClient("127.0.0.1", server.port,
+                               request_timeout=0.05)
+        assert client.request_timeout == 0.05
+        # no request with this id ever streams: result() must time out
+        # with the rid in the message
+        with pytest.raises(TimeoutError, match="request 12345"):
+            client.result(12345)
+        # per-call override still wins
+        with pytest.raises(TimeoutError, match="request 12345"):
+            client.result(12345, timeout=0.01)
+        # a live request still works under the short default
+        client2 = ServingClient("127.0.0.1", server.port,
+                                request_timeout=30.0)
+        p = np.arange(1, 6, dtype=np.int32)
+        rid = client2.generate(p, max_new_tokens=3)
+        toks, reason = client2.result(rid)
+        assert toks == _solo(model, params, p, max_new_tokens=3)
+        assert reason == "length"
+        client.close()
+        client2.close()
+    finally:
+        server.stop()
+
+
 def test_submit_validation():
     model, params = _model_and_params()
     eng = ServingEngine(model, params, slots=1)
