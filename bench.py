@@ -312,7 +312,41 @@ def main():
     # free the CNN buffers before the (much larger) LM workload
     del params, opt_state, x, y
     out.update(lm_bench())
+    out.update(serve_interference_bench())
     print(json.dumps(out))
+
+
+def serve_interference_bench():
+    """Chunked-prefill serving numbers for the BENCH trajectory: p99
+    inter-token latency of live decode streams under long-prompt
+    arrivals, chunked mixed ticks vs monolithic prefill, with the full
+    ITL histograms. Self-asserts are off (``checks=False``) and errors
+    are folded into the JSON — a serving regression must show up as a
+    worse number, never as a missing flagship line."""
+    import os
+    import sys
+
+    sys.path.insert(
+        0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "benchmarks"))
+    try:
+        import serve_bench
+
+        r = serve_bench.bench_long_prompt_interference(
+            smoke=True, checks=False)
+        return {
+            "serve_itl_p99_reduction": r["itl_p99_reduction"],
+            "serve_chunked_itl_ms_p99": r["chunked_itl_ms_p99"],
+            "serve_monolithic_itl_ms_p99": r["monolithic_itl_ms_p99"],
+            "serve_chunked_tokens_per_sec": r["chunked_tokens_per_sec"],
+            "serve_monolithic_tokens_per_sec":
+                r["monolithic_tokens_per_sec"],
+            "serve_chunked_itl_hist": r["chunked_itl_hist"],
+            "serve_monolithic_itl_hist": r["monolithic_itl_hist"],
+            "serve_itl_config": r["config"],
+        }
+    except Exception as e:  # pragma: no cover - accelerator-dependent
+        return {"serve_itl_error": f"{type(e).__name__}: {e}"}
 
 
 if __name__ == "__main__":

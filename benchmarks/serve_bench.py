@@ -33,6 +33,18 @@ prefill skipped) vs OFF (every prompt fully prefilled) — comparing TTFT.
 ``--smoke`` is the tiny CI variant: few requests, asserts the prefix-hit
 fraction is actually > 0 and the hit counters are visible in the
 Prometheus exposition, so bench drift is caught in tier-1.
+
+``--long-prompt-interference`` is the chunked-prefill bench (Sarathi's
+headline scenario): a closed-loop population of short-prompt/long-decode
+streams decodes steadily while long prompts keep arriving. Served twice
+— chunked mixed ticks (prefill rides the decode tick under the token
+budget) vs the legacy monolithic prefill (every long prompt is one
+whole-prompt dispatch that stalls every live stream) — comparing the
+short streams' p99 inter-token latency at the sustained token rate.
+ITLs are exact (client-side per-token timestamps); the engines'
+serving_itl_ms histograms land in the JSON for the BENCH trajectory.
+The ``--smoke`` variant self-asserts stream parity with solo
+``generate()`` and ``chunked p99 ITL < monolithic p99 ITL``.
 """
 
 import argparse
@@ -264,6 +276,255 @@ def bench_shared_prefix(V=1024, D=256, H=4, L=4, slots=8, n_requests=16,
     return result
 
 
+def bench_long_prompt_interference(
+        V=1024, D=256, H=4, L=4, slots=4,
+        n_short=24, short_prompt=16, short_new=32,
+        n_long=6, long_prompt=1024, long_new=4, long_every=4,
+        prefill_chunk=64, tick_token_budget=None, think_time=0.0,
+        dtype="float32", smoke=False, checks=True):
+    """p99 inter-token latency of live decode streams while long prompts
+    keep arriving: chunked mixed-tick prefill vs monolithic prefill.
+
+    Load shape: a closed-loop population of ``slots - 1`` short
+    requests decodes continuously (each completion immediately submits
+    the next, so decode pressure is constant); after every
+    ``long_every`` short completions one ``long_prompt``-token request
+    is submitted into the remaining slot. Monolithic mode runs each
+    long prompt as ONE whole-prompt dispatch between ticks — every
+    short stream's next token waits it out (the ITL spike). Chunked
+    mode streams it ``prefill_chunk`` tokens per tick under
+    ``tick_token_budget``, decodes riding the same dispatch.
+
+    ITL is measured exactly, client-side: a consumer thread per short
+    request timestamps each token; gaps after the first token are the
+    samples. Throughput is all generated tokens (short + long) over the
+    makespan. ``think_time`` inserts a per-completion pause before the
+    next closed-loop short is submitted: at 0 the system is saturated
+    (every CPU cycle of chunk padding shows up as lost throughput —
+    the worst case for chunking); > 0 models paced traffic with idle
+    headroom, where both modes serve the same offered load and the ITL
+    tail is the discriminator. ``checks=False`` disables the smoke
+    self-asserts (for embedding in the flagship bench.py run, where a
+    different accelerator's timing profile must not fail the whole
+    BENCH line)."""
+    from distkeras_tpu import telemetry
+    from distkeras_tpu.models import get_model
+    from distkeras_tpu.models.transformer import generate
+    from distkeras_tpu.serving import FIFOScheduler, ServingEngine
+
+    if smoke:
+        # sized so the monolithic long-prompt prefill COMPUTE dominates
+        # per-dispatch host overhead — measured on a 1-core CPU worker:
+        # prefill[1,1024] ≈ 260 ms (attention-quadratic) vs mixed
+        # tick[3,32] ≈ 15 ms, an order of magnitude between the stall
+        # and its chunked replacement, so the p99 comparison is
+        # physics, not jitter. Any smaller a model/prompt and the bench
+        # measures Python dispatch, not the stall it guards against.
+        # slots=3 keeps TWO shorts decoding in closed loop, so a long
+        # fired at one short's completion always has another short
+        # mid-stream to feel (or not feel) the stall.
+        V, D, H, L, slots = 64, 256, 4, 2, 3
+        n_short, short_prompt, short_new = 8, 8, 8
+        n_long, long_prompt, long_new, long_every = 3, 1024, 2, 2
+        prefill_chunk = 32
+    if tick_token_budget is None:
+        # one full chunk of prefill alongside every decode, per tick
+        tick_token_budget = slots + prefill_chunk
+    max_len = long_prompt + max(long_new, short_new)
+    model = get_model(
+        "transformer_lm", vocab_size=V, d_model=D, num_heads=H,
+        num_layers=L, max_len=max_len, dtype=jnp.dtype(dtype),
+        attention="dense",
+    )
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32))
+    rng = np.random.default_rng(0)
+    shorts = [rng.integers(0, V, size=short_prompt).astype(np.int32)
+              for _ in range(n_short)]
+    # staggered output lengths: equal lengths would let the closed-loop
+    # population complete in lockstep, so every long prompt would land
+    # BETWEEN streams (TTFT, not ITL) and the stall would be invisible
+    # to the metric this bench exists to measure
+    short_lens = rng.integers(max(2, short_new // 2), short_new + 1,
+                              size=n_short)
+    longs = [rng.integers(0, V, size=long_prompt).astype(np.int32)
+             for _ in range(n_long)]
+
+    def run(chunked):
+        # warm a THROWAWAY engine through every shape the measured run
+        # uses (jit caches key on module config, so the measured engine
+        # reuses the compiled tick/prefill programs)
+        warm = ServingEngine(
+            model, params, slots=slots,
+            registry=telemetry.MetricRegistry(), tracer=telemetry.Tracer(),
+            prefill_chunk=prefill_chunk if chunked else None,
+            scheduler=FIFOScheduler(tick_token_budget=tick_token_budget,
+                                    registry=telemetry.MetricRegistry(),
+                                    tracer=telemetry.Tracer()),
+        )
+        warm.submit(shorts[0], max_new_tokens=2)
+        warm.submit(longs[0], max_new_tokens=2)
+        warm.drain()
+
+        registry = telemetry.MetricRegistry()
+        engine = ServingEngine(
+            model, params, slots=slots, registry=registry,
+            tracer=telemetry.Tracer(),
+            prefill_chunk=prefill_chunk if chunked else None,
+            scheduler=FIFOScheduler(tick_token_budget=tick_token_budget,
+                                    registry=telemetry.MetricRegistry(),
+                                    tracer=telemetry.Tracer()),
+        )
+        stop = threading.Event()
+        loop = threading.Thread(target=engine.serve_forever, args=(stop,),
+                                daemon=True)
+        lock = threading.Lock()
+        itls, streams = [], {}  # streams: short idx -> emitted tokens
+        tokens = [0]
+        short_left = list(enumerate(shorts))
+        long_left = list(longs)
+        short_done, long_done, long_fired = [0], [0], [0]
+        threads = []
+
+        def consume_long(req):
+            n = len(req.stream.tokens(timeout=120))
+            with lock:
+                tokens[0] += n
+                long_done[0] += 1
+
+        def consume(idx, req):
+            stamps, toks = [], []
+            for tok in req.stream:
+                stamps.append(time.perf_counter())
+                toks.append(tok)
+            with lock:
+                tokens[0] += len(toks)
+                streams[idx] = toks
+                itls.extend(
+                    (b - a) * 1e3 for a, b in zip(stamps, stamps[1:])
+                )
+                short_done[0] += 1
+                # closed loop: a finished short immediately feeds the
+                # next one in; every long_every-th completion also
+                # launches a long prompt into the spare slot
+                nxt = short_left.pop(0) if short_left else None
+                fire_long = (long_left
+                             and short_done[0] % long_every == 0)
+                lng = long_left.pop(0) if fire_long else None
+                if lng is not None:
+                    long_fired[0] += 1
+            if lng is not None:
+                rl = engine.submit(lng, max_new_tokens=long_new)
+                tl = threading.Thread(target=consume_long, args=(rl,),
+                                      daemon=True)
+                tl.start()
+                with lock:
+                    threads.append(tl)
+            if nxt is not None:
+                if think_time > 0:
+                    time.sleep(think_time)
+                i, p = nxt
+                r = engine.submit(p, max_new_tokens=int(short_lens[i]))
+                t = threading.Thread(target=consume, args=(i, r),
+                                     daemon=True)
+                t.start()
+                with lock:
+                    threads.append(t)
+
+        t0 = time.perf_counter()
+        loop.start()
+        with lock:
+            seeds = [short_left.pop(0)
+                     for _ in range(min(max(slots - 1, 1),
+                                        len(short_left)))]
+        for i, p in seeds:
+            r = engine.submit(p, max_new_tokens=int(short_lens[i]))
+            t = threading.Thread(target=consume, args=(i, r), daemon=True)
+            t.start()
+            with lock:
+                threads.append(t)
+        deadline = time.monotonic() + 600
+        while time.monotonic() < deadline:
+            with lock:
+                if (short_done[0] >= n_short
+                        and long_done[0] >= long_fired[0]):
+                    break
+            time.sleep(0.005)
+        dt = time.perf_counter() - t0
+        stop.set()
+        loop.join(timeout=10)
+        while True:
+            with lock:
+                pend = [t for t in threads if t.is_alive()]
+            if not pend:
+                break
+            pend[0].join(timeout=10)
+        with lock:
+            vals = sorted(itls)
+            total = tokens[0]
+        p50 = vals[int(0.50 * (len(vals) - 1))] if vals else None
+        p99 = vals[int(0.99 * (len(vals) - 1))] if vals else None
+        return {
+            "itl_ms_p50": p50, "itl_ms_p99": p99,
+            "itl_ms_max": vals[-1] if vals else None,
+            "itl_samples": len(vals),
+            "tokens_per_sec": round(total / dt, 1),
+            "itl_hist": registry.histogram("serving_itl_ms").value,
+            "decode_stalls": registry.counter(
+                "serving_decode_stalls_total").value,
+            "streams": streams,
+        }
+
+    chunked = run(chunked=True)
+    mono = run(chunked=False)
+    if smoke and checks:
+        # parity guard: every short stream, in BOTH modes, must be
+        # token-identical to a solo generate() of the same prompt
+        for mode in (chunked, mono):
+            assert len(mode["streams"]) == n_short
+            for i, toks in mode["streams"].items():
+                want = np.asarray(generate(
+                    model, params, jnp.asarray(shorts[i])[None],
+                    int(short_lens[i])
+                ))[0, short_prompt:].tolist()
+                assert toks == want, (i, toks, want)
+    result = {
+        "chunked_itl_ms_p99": chunked["itl_ms_p99"],
+        "monolithic_itl_ms_p99": mono["itl_ms_p99"],
+        "itl_p99_reduction": (
+            round(mono["itl_ms_p99"] / chunked["itl_ms_p99"], 2)
+            if chunked["itl_ms_p99"] else None
+        ),
+        "chunked_itl_ms_p50": chunked["itl_ms_p50"],
+        "monolithic_itl_ms_p50": mono["itl_ms_p50"],
+        "chunked_itl_ms_max": chunked["itl_ms_max"],
+        "monolithic_itl_ms_max": mono["itl_ms_max"],
+        "chunked_tokens_per_sec": chunked["tokens_per_sec"],
+        "monolithic_tokens_per_sec": mono["tokens_per_sec"],
+        "monolithic_decode_stalls": mono["decode_stalls"],
+        "chunked_decode_stalls": chunked["decode_stalls"],
+        "chunked_itl_samples": chunked["itl_samples"],
+        "monolithic_itl_samples": mono["itl_samples"],
+        "chunked_itl_hist": chunked["itl_hist"],
+        "monolithic_itl_hist": mono["itl_hist"],
+        "config": f"d{D}/h{H}/L{L}/v{V}-slots{slots}"
+                  f"-short{short_prompt}+{short_new}x{n_short}"
+                  f"-long{long_prompt}+{long_new}x{n_long}"
+                  f"-chunk{prefill_chunk}-budget{tick_token_budget}"
+                  + (f"-think{think_time}" if think_time else "")
+                  + f"-{dtype}" + ("-smoke" if smoke else ""),
+    }
+    if smoke and checks:
+        # CI drift guards: the chunked engine must actually remove the
+        # monolithic prefill stall from the decode streams, and the
+        # monolithic engine must have seen stalls at all (otherwise the
+        # scenario stopped exercising interference)
+        assert mono["decode_stalls"] > 0, result
+        assert chunked["decode_stalls"] == 0, result
+        assert chunked["itl_ms_p99"] < mono["itl_ms_p99"], result
+    print(json.dumps(result), flush=True)
+    return result
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--slots", type=int, default=8)
@@ -277,13 +538,40 @@ def main():
     ap.add_argument("--shared-prefix", action="store_true",
                     help="paged-engine prefix-caching TTFT bench "
                          "(90%% shared system prompts)")
+    ap.add_argument("--long-prompt-interference", action="store_true",
+                    help="chunked-prefill ITL bench: short decode "
+                         "streams vs a stream of long prompts, chunked "
+                         "mixed ticks vs monolithic prefill")
     ap.add_argument("--smoke", action="store_true",
-                    help="tiny shared-prefix run asserting prefix hits "
-                         "> 0 (CI drift guard)")
+                    help="tiny self-asserting CI variant of "
+                         "--shared-prefix (default) or "
+                         "--long-prompt-interference")
     ap.add_argument("--prefix-len", type=int, default=None,
                     help="shared system-prompt length (default 256)")
     ap.add_argument("--block-size", type=int, default=16)
+    ap.add_argument("--long-prompt", type=int, default=None,
+                    help="interference bench: long-prompt length "
+                         "(default 1024)")
+    ap.add_argument("--prefill-chunk", type=int, default=None,
+                    help="interference bench: chunk size C (default 64)")
+    ap.add_argument("--tick-token-budget", type=int, default=None,
+                    help="interference bench: per-tick token budget "
+                         "(default slots + chunk)")
+    ap.add_argument("--think-time", type=float, default=0.0,
+                    help="interference bench: pause (s) before each "
+                         "closed-loop short refill — 0 saturates, > 0 "
+                         "models paced traffic with idle headroom")
     args = ap.parse_args()
+    if args.long_prompt_interference:
+        kw = dict(slots=args.slots, dtype=args.dtype, smoke=args.smoke,
+                  tick_token_budget=args.tick_token_budget,
+                  think_time=args.think_time)
+        if args.long_prompt is not None:
+            kw["long_prompt"] = args.long_prompt
+        if args.prefill_chunk is not None:
+            kw["prefill_chunk"] = args.prefill_chunk
+        bench_long_prompt_interference(**kw)
+        return
     if args.shared_prefix or args.smoke:
         kw = dict(slots=args.slots, block_size=args.block_size,
                   dtype=args.dtype, smoke=args.smoke)

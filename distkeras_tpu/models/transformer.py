@@ -242,14 +242,22 @@ class CausalSelfAttention(nn.Module):
 
     _DENSE_MAX_T = 512  # short sequences: one fused dense block is fastest
 
-    def _paged_attend(self, q, k, v, block_tables, seq_lens):
+    def _paged_attend(self, q, k, v, block_tables, seq_lens,
+                      valid_lens=None):
         """Paged twin of :meth:`_cached_attend`: same rope-at-cursor,
         same grouped attend, same masks — but K/V live in the global
         block pool and this row's view of it is assembled by gathering
         its block table. Writes land at each token's (block, offset);
         the caller guarantees a row only ever writes blocks it owns
         exclusively (copy-on-write upstream), so the scatter never
-        races a shared prefix."""
+        races a shared prefix.
+
+        ``valid_lens`` ([B] int32) marks the chunked mixed
+        prefill/decode tick: row b's first ``valid_lens[b]`` tokens are
+        real (a prompt chunk, or one sampled decode token), the rest is
+        padding whose K/V writes are steered to the reserved trash
+        block 0 — positions stay absolute, so the cache bytes are
+        bit-identical to an unchunked prefill of the same prompt."""
         B, T, H, hd = q.shape
         Hk = k.shape[2]
         G = H // Hk
@@ -278,8 +286,17 @@ class CausalSelfAttention(nn.Module):
             k = apply_rope(k, pos)
         # token t of row b lands in physical block table[pos // bs] at
         # offset pos % bs; idle rows point at the reserved trash block
-        blk = jnp.take_along_axis(block_tables, pos // bs, axis=1)
+        blk = jnp.take_along_axis(
+            block_tables, jnp.minimum(pos // bs, max_blocks - 1), axis=1
+        )
         off = pos % bs
+        if valid_lens is not None:
+            # chunk padding (t >= valid_lens[b]) writes to the trash
+            # block, exactly like an idle row — a padded mixed tick
+            # leaves the same cache bytes as an exact-length prefill
+            blk = jnp.where(
+                jnp.arange(T)[None, :] < valid_lens[:, None], blk, 0
+            )
 
         def put(cache, new):
             return cache.at[blk, off].set(new.astype(cache.dtype))
@@ -316,7 +333,7 @@ class CausalSelfAttention(nn.Module):
         out = jnp.einsum("bkgql,blkd->bqkgd", p.astype(self.dtype), vals)
         return out.reshape(B, T, H, hd)
 
-    def _cached_attend(self, q, k, v):
+    def _cached_attend(self, q, k, v, valid_lens=None):
         """Write this call's K/V at the cache cursor, attend q over the
         whole cache with a positions-seen-so-far mask. Works for a
         multi-token prefill and for one-token decode steps alike.
@@ -325,7 +342,14 @@ class CausalSelfAttention(nn.Module):
         that is the whole point: the per-step HBM stream of a
         bandwidth-bound decode drops by H/Hk. Queries attend grouped
         (``g`` = queries per KV head) without materializing repeated
-        K/V."""
+        K/V.
+
+        ``valid_lens`` ([B] int32, slot_cursor only) is the chunked
+        mixed prefill/decode tick: row b consumes only its first
+        ``valid_lens[b]`` tokens — K/V writes for the padding tail are
+        dropped (scatter mode='drop' past the cache) and the cursor
+        advances by the valid count, so a prompt streamed chunk-by-chunk
+        leaves bit-identical cache bytes to one monolithic prefill."""
         B, T, H, hd = q.shape
         # LOCAL KV head count from k itself: under tensor parallelism H
         # and k.shape[2] are this shard's slices, and the global
@@ -371,6 +395,21 @@ class CausalSelfAttention(nn.Module):
             k = apply_rope(k, pos)
 
         def put(cache, new):
+            if valid_lens is not None:
+                # chunked mixed tick: scatter each row's VALID tokens at
+                # its cursor; padding positions are pushed past L and
+                # dropped, so they can neither clobber history (the
+                # dynamic_update_slice clamp would) nor leave garbage
+                # the next chunk hasn't overwritten
+                tpos = jnp.where(
+                    jnp.arange(new.shape[1])[None, :]
+                    < valid_lens[:, None],
+                    cur[:, None] + jnp.arange(new.shape[1])[None, :],
+                    L,
+                )
+                return cache.at[jnp.arange(cache.shape[0])[:, None],
+                                tpos].set(new.astype(cache.dtype),
+                                          mode="drop")
             if self.slot_cursor:
                 # each slot writes at its own cursor
                 return jax.vmap(
@@ -397,7 +436,7 @@ class CausalSelfAttention(nn.Module):
             ck.value = put(ck.value, k.astype(self.dtype))
             cv.value = put(cv.value, v.astype(self.dtype))
             keys, vals = ck.value, cv.value
-        idx.value = cur + T
+        idx.value = cur + (T if valid_lens is None else valid_lens)
         scale = 1.0 / np.sqrt(hd)
         qg = q.reshape(B, T, Hk, G, hd)
         s = jnp.einsum(
@@ -416,7 +455,8 @@ class CausalSelfAttention(nn.Module):
         return out.reshape(B, T, H, hd)
 
     @nn.compact
-    def __call__(self, x, block_tables=None, seq_lens=None):
+    def __call__(self, x, block_tables=None, seq_lens=None,
+                 valid_lens=None):
         B, T, D = x.shape
         H = self.num_heads
         hd = D // H
@@ -435,6 +475,11 @@ class CausalSelfAttention(nn.Module):
             raise ValueError(
                 "slot_cursor=True (per-row cache cursors) only makes "
                 "sense with decode=True"
+            )
+        if valid_lens is not None and not (self.slot_cursor or self.paged):
+            raise ValueError(
+                "valid_lens (chunked mixed prefill/decode) needs per-row "
+                "cursors: slot_cursor=True or paged=True"
             )
         if self.paged:
             if not self.decode:
@@ -506,9 +551,10 @@ class CausalSelfAttention(nn.Module):
             if self.cache_len <= 0:
                 raise ValueError("decode mode needs cache_len > 0")
             if self.paged:
-                out = self._paged_attend(q, k, v, block_tables, seq_lens)
+                out = self._paged_attend(q, k, v, block_tables, seq_lens,
+                                         valid_lens)
             else:
-                out = self._cached_attend(q, k, v)
+                out = self._cached_attend(q, k, v, valid_lens)
             return TPDenseGeneral(
                 features=(D,), in_axes=2, mode="row",
                 tp_size=self.tp_size, tp_axis=self.tp_axis,
@@ -606,7 +652,8 @@ class Block(nn.Module):
     num_pages: int = 0
 
     @nn.compact
-    def __call__(self, x, block_tables=None, seq_lens=None):
+    def __call__(self, x, block_tables=None, seq_lens=None,
+                 valid_lens=None):
         D = x.shape[-1]
         h = nn.LayerNorm(dtype=self.dtype)(x)
         x = x + CausalSelfAttention(
@@ -619,7 +666,7 @@ class Block(nn.Module):
             paged=self.paged,
             page_block_size=self.page_block_size,
             num_pages=self.num_pages,
-        )(h, block_tables, seq_lens)
+        )(h, block_tables, seq_lens, valid_lens)
         h = nn.LayerNorm(dtype=self.dtype)(x)
         if self.moe_experts > 0:
             from distkeras_tpu.ops.moe import SwitchMoE
@@ -725,7 +772,7 @@ class TransformerLM(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, train: bool = False,
-                 block_tables=None, seq_lens=None):
+                 block_tables=None, seq_lens=None, valid_lens=None):
         if self.remat not in ("none", "block"):
             raise ValueError(
                 f"Unknown remat policy '{self.remat}'. Known: none, block"
@@ -784,8 +831,20 @@ class TransformerLM(nn.Module):
                                      + pos_idx.value[:, None])
                     else:
                         local_pos = local_pos + pos_idx.value
-                    pos_idx.value = pos_idx.value + x.shape[1]
-            taken = jnp.take(pos_table, local_pos, axis=0)
+                    # chunked mixed tick: each row advances by its own
+                    # valid count (padding consumes no positions);
+                    # padded tail positions may run past max_len —
+                    # jnp.take clips, and those rows' outputs are
+                    # garbage the engine never reads
+                    pos_idx.value = pos_idx.value + (
+                        x.shape[1] if valid_lens is None else valid_lens
+                    )
+            # mode="clip": a chunked mixed tick's padding positions can
+            # run past max_len; the default OOB fill would hand those
+            # tokens NaN embeddings, whose K/V lands in the paged trash
+            # block and 0·NaN-poisons every row that gathers it. Clipped
+            # garbage is finite, so masked positions contribute exactly 0.
+            taken = jnp.take(pos_table, local_pos, axis=0, mode="clip")
             if taken.ndim == 2:  # shared positions: broadcast over batch
                 taken = taken[None]
             x = x + taken.astype(self.dtype)
@@ -814,7 +873,7 @@ class TransformerLM(nn.Module):
                 page_block_size=self.page_block_size,
                 num_pages=self.num_pages,
                 name=f"Block_{i}",
-            )(x, block_tables, seq_lens)
+            )(x, block_tables, seq_lens, valid_lens)
         x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
         if self.features_only:
             return x

@@ -6,7 +6,10 @@ request throughput: a fixed pool of KV-cache slots advanced by one jitted
 decode step per tick (:mod:`engine`), an admission queue with
 backpressure and deadlines (:mod:`scheduler`), and a TCP front-end that
 streams tokens per request over the framed-msgpack transport
-(:mod:`server`). With ``ServingEngine(paged=True)`` the slot slabs
+(:mod:`server`). Prompts stream into their slot chunk-by-chunk
+*inside* the decode tick (Sarathi-style chunked prefill under the
+scheduler's ``tick_token_budget``), so a long prompt never stalls the
+live decode streams. With ``ServingEngine(paged=True)`` the slot slabs
 become a pool of fixed-size KV blocks (:mod:`kvpool`) with radix-tree
 prompt-prefix sharing (:mod:`prefix`): repeated system prompts are
 prefilled once and reference-counted, with copy-on-write at mid-block
@@ -23,6 +26,7 @@ from distkeras_tpu.serving.prefix import (  # noqa: F401
     RadixPrefixIndex,
 )
 from distkeras_tpu.serving.scheduler import (  # noqa: F401
+    DEFAULT_PREFILL_CHUNK,
     FIFOScheduler,
     QueueFullError,
     Request,
@@ -35,6 +39,7 @@ from distkeras_tpu.serving.server import (  # noqa: F401
 
 __all__ = [
     "ServingEngine",
+    "DEFAULT_PREFILL_CHUNK",
     "BlockPool",
     "OutOfBlocksError",
     "PrefixMatch",

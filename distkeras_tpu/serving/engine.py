@@ -11,15 +11,27 @@ row. This engine removes both taxes while keeping every shape static
   (``slots``), the same GQA/int8 layout ``CausalSelfAttention`` already
   uses, but with ``slot_cursor=True``: the cache cursor is a ``[S]``
   vector, so each batch row is an independent sequence at its own depth.
-- **Per-slot prefill.** A joining prompt runs the ordinary B=1 decode
-  prefill (bit-identical to ``generate``'s), and its cache — K/V rows,
-  int8 scales, cursors — is scattered into the slot with
-  ``dynamic_update_slice``. No other slot is touched.
-- **One jitted tick.** Each tick samples one token per slot from the
-  pooled last-logits (per-slot sampling config and RNG chain, same math
-  as a solo ``generate``) and advances all ``S`` slots through one
-  decode step. Ticks are compiled once per distinct per-slot sampling
-  configuration tuple.
+- **Chunked prefill, fused into the tick** (Sarathi-Serve-style; the
+  default). A joining prompt never runs as one monolithic prefill
+  dispatch: it streams into its slot ``prefill_chunk`` tokens per tick,
+  coalesced with the decoding rows into ONE ``[S, C]`` mixed dispatch —
+  each row at its own per-row valid length (decoding rows carry 1
+  token, prefilling rows up to C), K/V written at absolute per-row
+  positions, logits taken at each row's last valid token. The
+  scheduler's ``tick_token_budget`` meters how many prompt tokens each
+  tick carries (decodes reserved first), so a 2048-token prompt costs
+  live streams a bounded per-tick overhead instead of a
+  multi-hundred-ms inter-token-latency spike. ``prefill_chunk=None``
+  restores the legacy monolithic B=1 prefill scattered in with
+  ``dynamic_update_slice`` (kept as the bench baseline).
+- **One jitted tick.** Each tick samples one token per decoding slot
+  from the pooled last-logits (per-slot sampling config and RNG chain,
+  same math as a solo ``generate``; a slot's RNG only advances on ticks
+  it sampled) and advances all ``S`` slots through one mixed step.
+  Ticks are compiled once per distinct per-slot sampling configuration
+  tuple — twice with chunking (the ``[S, C]`` mixed shape and the
+  ``[S, 1]`` all-decode shape), so an all-decode steady state pays
+  exactly the unchunked tick.
 - **Same-tick refill.** A slot whose request sampled its eos (or hit its
   token budget) is freed when the tick's tokens are processed and
   refilled from the scheduler queue in the same :meth:`step` call — the
@@ -38,7 +50,8 @@ Observability is the :mod:`distkeras_tpu.telemetry` layer: every request
 leaves a span chain (``queued → prefill → decode → finish``, with slot
 id and token counts) in the tracer, and the engine publishes live
 counters/gauges/histograms (tick count, tokens, occupancy, queue depth,
-TTFT, per-token latency, prefill fraction) into a
+TTFT, per-token latency, per-stream inter-token latency
+``serving_itl_ms``, decode-stall count, prefill fraction) into a
 :class:`~distkeras_tpu.telemetry.MetricRegistry` — scrapeable over the
 msgpack ``stats``/``trace_dump`` ops and the HTTP endpoint. The
 per-tick/per-request JSONL records still ride
@@ -63,7 +76,11 @@ from distkeras_tpu import telemetry
 from distkeras_tpu.models.transformer import sample_tokens
 from distkeras_tpu.serving.kvpool import BlockPool
 from distkeras_tpu.serving.prefix import RadixPrefixIndex
-from distkeras_tpu.serving.scheduler import FIFOScheduler, Request
+from distkeras_tpu.serving.scheduler import (
+    DEFAULT_PREFILL_CHUNK,
+    FIFOScheduler,
+    Request,
+)
 from distkeras_tpu.utils.metrics import MetricsWriter
 
 
@@ -75,7 +92,7 @@ def _prefill_fn(dm_one):
     Cached per decode-module config; each distinct prompt length traces
     its own prefill, exactly like ``generate``."""
 
-    @jax.jit
+    @functools.partial(jax.jit, donate_argnums=(1, 2))
     def prefill(params_only, pooled, last_logits, prompt, slot):
         cache1 = jax.tree.map(
             lambda s: jnp.zeros(s.shape, s.dtype),
@@ -114,7 +131,7 @@ def _tick_fn(dm_slot, cfgs):
     the exact call shape of a solo B=1 ``generate``, so streams are
     token-identical), then advance all slots one decode step."""
 
-    @jax.jit
+    @functools.partial(jax.jit, donate_argnums=(1, 2, 3))
     def tick(params_only, cache, last_logits, rngs):
         toks, new_rngs = [], []
         for s, (temp, top_k, top_p) in enumerate(cfgs):
@@ -143,7 +160,7 @@ def _paged_prefill_fn(dm_paged):
     request computed them first). The cache IS the global pool, so
     unlike the slot path there is no per-slot scatter-merge step."""
 
-    @jax.jit
+    @functools.partial(jax.jit, donate_argnums=(1, 2))
     def prefill(params_only, cache, last_logits, suffix, table, start,
                 slot):
         logits, vs = dm_paged.apply(
@@ -159,12 +176,103 @@ def _paged_prefill_fn(dm_paged):
 
 
 @functools.lru_cache(maxsize=256)
+def _mixed_tick_fn(dm_slot, cfgs, chunk):
+    """Compiled CHUNKED mixed prefill/decode tick (the Sarathi-style
+    fused step): one ``[S, chunk]`` dispatch advances every slot —
+    decoding rows consume 1 valid token (their own freshly-sampled
+    one), prefilling rows consume up to ``chunk`` prompt tokens, idle
+    rows run padding. Per-slot sampling is identical to :func:`_tick_fn`
+    (same RNG chains, same ``[1, vocab]`` call shape), but a slot's RNG
+    only advances when it actually sampled (``sample_mask``) — prefill
+    ticks must not burn the chain that makes streams token-identical to
+    solo ``generate()``. Logits are taken at each row's LAST VALID
+    token, so the tick that feeds a prompt's final chunk leaves exactly
+    the logits a monolithic prefill would have."""
+
+    @functools.partial(jax.jit, donate_argnums=(1, 2, 3))
+    def tick(params_only, cache, last_logits, rngs, fed, valid,
+             sample_mask):
+        toks, new_rngs = [], []
+        for s, (temp, top_k, top_p) in enumerate(cfgs):
+            rng, sub = jax.random.split(rngs[s])
+            toks.append(
+                sample_tokens(last_logits[s][None], sub, temp,
+                              top_k, top_p)[0]
+            )
+            new_rngs.append(jnp.where(sample_mask[s], rng, rngs[s]))
+        sampled = jnp.stack(toks)  # [S]
+        inputs = fed.at[:, 0].set(
+            jnp.where(sample_mask, sampled, fed[:, 0])
+        )
+        logits, vs = dm_slot.apply(
+            {**params_only, "cache": cache}, inputs,
+            valid_lens=valid, mutable=["cache"],
+        )
+        # row s's next-step logits live at its last valid token; a
+        # starved prefill row (valid 0) wraps to garbage it never reads
+        last = jnp.take_along_axis(
+            logits, jnp.maximum(valid - 1, 0)[:, None, None], axis=1
+        )[:, 0]
+        return vs["cache"], last, sampled, jnp.stack(new_rngs)
+
+    return tick
+
+
+@functools.lru_cache(maxsize=256)
+def _paged_mixed_tick_fn(dm_paged, cfgs, chunk):
+    """Paged twin of :func:`_mixed_tick_fn`: same fused
+    sample/feed/advance semantics, with K/V reads and writes routed
+    through each row's block table (chunk padding lands in the reserved
+    trash block)."""
+
+    @functools.partial(jax.jit, donate_argnums=(1, 2, 3))
+    def tick(params_only, cache, last_logits, rngs, tables, lens, fed,
+             valid, sample_mask):
+        toks, new_rngs = [], []
+        for s, (temp, top_k, top_p) in enumerate(cfgs):
+            rng, sub = jax.random.split(rngs[s])
+            toks.append(
+                sample_tokens(last_logits[s][None], sub, temp,
+                              top_k, top_p)[0]
+            )
+            new_rngs.append(jnp.where(sample_mask[s], rng, rngs[s]))
+        sampled = jnp.stack(toks)
+        inputs = fed.at[:, 0].set(
+            jnp.where(sample_mask, sampled, fed[:, 0])
+        )
+        logits, vs = dm_paged.apply(
+            {**params_only, "cache": cache}, inputs,
+            block_tables=tables, seq_lens=lens, valid_lens=valid,
+            mutable=["cache"],
+        )
+        last = jnp.take_along_axis(
+            logits, jnp.maximum(valid - 1, 0)[:, None, None], axis=1
+        )[:, 0]
+        return vs["cache"], last, sampled, jnp.stack(new_rngs)
+
+    return tick
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _reset_slot_cursors(cache, slot):
+    """Park slot ``slot`` at depth 0 for its next tenant: the [S]
+    cursor vectors (cache_index per layer, pos_index) zero out; the K/V
+    slabs stay — every position a new request attends is rewritten by
+    its own chunks before any query can reach it (causal mask at the
+    row's own cursor), so stale bytes beyond the cursor are
+    unreachable."""
+    return jax.tree.map(
+        lambda c: c.at[slot].set(0) if c.ndim == 1 else c, cache
+    )
+
+
+@functools.lru_cache(maxsize=256)
 def _paged_tick_fn(dm_paged, cfgs):
     """Paged twin of :func:`_tick_fn`: identical per-slot sampling (same
     RNG chains, same [1, vocab] call shape), then one decode step whose
     K/V reads/writes go through each row's block table."""
 
-    @jax.jit
+    @functools.partial(jax.jit, donate_argnums=(1, 2, 3))
     def tick(params_only, cache, last_logits, rngs, tables, lens):
         toks, new_rngs = [], []
         for s, (temp, top_k, top_p) in enumerate(cfgs):
@@ -184,7 +292,7 @@ def _paged_tick_fn(dm_paged, cfgs):
     return tick
 
 
-@jax.jit
+@functools.partial(jax.jit, donate_argnums=(0,))
 def _copy_block(cache, src, dst):
     """Copy-on-write: duplicate physical block ``src`` into ``dst``
     across every paged cache leaf (K, V, int8 scales — all block-major),
@@ -202,6 +310,13 @@ class _SlotState:
     remaining: int
     blocks: Optional[List[int]] = None  # paged: this row's block chain
     cached_tokens: int = 0  # paged: prompt tokens served from the index
+    # chunked prefill: prompt tokens not yet fed through a mixed tick
+    # (None = monolithic mode, already prefilled). A slot is PREFILLING
+    # while decoding is False and DECODING after its last chunk landed.
+    pending: Optional[np.ndarray] = None
+    decoding: bool = True
+    admit_seq: int = 0  # admission order: prefill budget is dealt FIFO
+    admit_t: float = 0.0  # monotonic admission time (prefill span)
 
 
 class ServingEngine:
@@ -241,6 +356,17 @@ class ServingEngine:
         prefix-cache headroom.
       prefix_cache: set False to disable radix prefix sharing (every
         prompt fully prefills; blocks free immediately at finish).
+      prefill_chunk: Sarathi-style chunked prefill (the default, C=64):
+        an admitted prompt streams into its slot C tokens at a time
+        *inside* the decode tick — one fused ``[S, C]`` dispatch
+        advances prefilling and decoding rows together, each row at its
+        own valid length, so a 2048-token prompt never injects a
+        monolithic-prefill stall into live streams. How many prompt
+        tokens each tick actually carries is metered by the scheduler's
+        ``tick_token_budget`` (decodes reserved first). ``None``
+        restores the legacy monolithic whole-prompt B=1 prefill
+        dispatch (kept as the bench baseline). Streams are
+        bit-identical either way, at any chunk size.
 
     Drive it with :meth:`step` (one admit→tick→complete→refill cycle,
     e.g. from a test) or :meth:`serve_forever` (the TCP front-end's
@@ -256,9 +382,17 @@ class ServingEngine:
                  tracer: Optional[telemetry.Tracer] = None,
                  paged: bool = False, block_size: int = 16,
                  num_blocks: Optional[int] = None,
-                 prefix_cache: bool = True):
+                 prefix_cache: bool = True,
+                 prefill_chunk: Optional[int] = DEFAULT_PREFILL_CHUNK):
         if slots < 1:
             raise ValueError(f"slots must be >= 1; got {slots}")
+        if prefill_chunk is not None and prefill_chunk < 1:
+            raise ValueError(
+                f"prefill_chunk must be >= 1 (or None for monolithic "
+                f"prefill); got {prefill_chunk}"
+            )
+        self.prefill_chunk = prefill_chunk
+        self._admit_seq = 0
         self.model = (model if max_len is None
                       else model.clone(max_len=max_len, parent=None))
         self.slots = slots
@@ -369,8 +503,18 @@ class ServingEngine:
             "serving_prefill_ms", "per-slot prefill dispatch (ms)")
         self._m_prefill_frac = reg.histogram(
             "serving_prefill_fraction",
-            "per step(): prefills / (prefills + decode tick)",
+            "per tick: prefill tokens / (prefill + decode tokens) "
+            "(chunked), or prefill dispatches / dispatches (monolithic)",
             buckets=telemetry.FRACTION_BUCKETS)
+        self._m_itl_ms = reg.histogram(
+            "serving_itl_ms",
+            "inter-token latency: gap between consecutive tokens of one "
+            "stream, host-observed (ms)")
+        self._m_decode_stalls = reg.counter(
+            "serving_decode_stalls_total",
+            "prefill dispatches that ran while decoding slots sat "
+            "waiting (monolithic prefill only; chunked prefill rides "
+            "the tick and never stalls a decode)")
         self._m_decode_tps = reg.gauge(
             "serving_decode_tokens_per_sec",
             "tokens emitted by the latest tick over its wall time")
@@ -425,21 +569,28 @@ class ServingEngine:
         return [st.req.rid if st else None for st in self._slots]
 
     def step(self) -> bool:
-        """One scheduler iteration: admit into free slots, run one decode
-        tick over the pool, emit tokens, free finished slots, and refill
-        them from the queue (same call — the freed slot never idles a
-        tick). Returns False when there is nothing to do."""
+        """One scheduler iteration: admit into free slots, run one tick
+        over the pool (mixed prefill/decode when chunked), emit tokens,
+        free finished slots, and refill them from the queue (same call —
+        the freed slot never idles a tick). Returns False when there is
+        nothing to do."""
         n_prefills = self._admit()
         occupied = any(st is not None for st in self._slots)
         if occupied:
-            self._decode_tick()
+            if self.prefill_chunk is not None:
+                self._mixed_tick()
+            else:
+                self._decode_tick()
             # EOS'd / exhausted slots were freed while processing the
             # tick's tokens: refill them NOW so the next tick decodes
             # their replacement requests (same-tick refill)
             n_prefills += self._admit()
-            # share of this step's device dispatches that were prefill
-            # passes (decode-latency pressure from arrival bursts)
-            self._m_prefill_frac.observe(n_prefills / (n_prefills + 1))
+            if self.prefill_chunk is None:
+                # share of this step's device dispatches that were
+                # prefill passes (decode-latency pressure from arrival
+                # bursts); the chunked path observes a per-tick TOKEN
+                # fraction inside _mixed_tick instead
+                self._m_prefill_frac.observe(n_prefills / (n_prefills + 1))
         return occupied or self.scheduler.depth() > 0
 
     def serve_forever(self, stop: threading.Event,
@@ -539,9 +690,17 @@ class ServingEngine:
         now = time.monotonic()
         self.tracer.record(req.trace_id, "queued", req.submit_t,
                            (now - req.submit_t) * 1e3)
+        if self.prefill_chunk is not None:
+            self._chunked_enter(slot, req, now)
+            return
         if self.paged:
             self._paged_prefill_into(slot, req, now)
             return
+        if any(st is not None and st.decoding for st in self._slots):
+            # this monolithic whole-prompt dispatch runs between ticks:
+            # every live decode stream waits it out (the ITL spike
+            # chunked prefill exists to remove)
+            self._m_decode_stalls.inc()
         prefill = _prefill_fn(self._dm_one)
         prompt = jnp.asarray(req.prompt, jnp.int32)[None]
         t0 = time.perf_counter()
@@ -562,14 +721,14 @@ class ServingEngine:
                            slot=slot, prompt_tokens=int(req.prompt.size))
         self._m_prefill_ms.observe(prefill_ms)
 
-    def _paged_prefill_into(self, slot: int, req: Request, now: float):
-        """Admit one request into a paged slot: reuse the radix-matched
-        prefix blocks (refcount bump, zero prefill), copy-on-write a
-        partially-shared block if the prompt diverges mid-block, then
-        prefill ONLY the uncached suffix at B=1 through the shared block
-        pool."""
+    def _paged_attach_blocks(self, req: Request):
+        """Shared paged admission bookkeeping: radix-match the prompt,
+        reuse the matched prefix blocks (refcount bump, zero prefill),
+        copy-on-write a partially-shared block if the prompt diverges
+        mid-block, allocate the rest. Returns ``(chain, cached)`` — the
+        row's physical block chain and how many leading prompt tokens
+        are already served by the cache."""
         bs = self.block_size
-        Tp = int(req.prompt.size)
         m = self.prefix.match(req.prompt) if self.prefix else None
         shared = list(m.blocks) if m else []
         total = self._blocks_for(req)
@@ -588,6 +747,16 @@ class ServingEngine:
                 self._cache, jnp.int32(src), jnp.int32(fresh[0])
             )
             cached += j
+        return chain, cached
+
+    def _paged_prefill_into(self, slot: int, req: Request, now: float):
+        """Admit one request into a paged slot (monolithic mode):
+        attach its block chain, then prefill ONLY the uncached suffix
+        at B=1 through the shared block pool."""
+        if any(st is not None and st.decoding for st in self._slots):
+            self._m_decode_stalls.inc()
+        Tp = int(req.prompt.size)
+        chain, cached = self._paged_attach_blocks(req)
         suffix = jnp.asarray(req.prompt[cached:], jnp.int32)[None]
         table = np.zeros((1, self._max_blocks), np.int32)
         table[0, :len(chain)] = chain
@@ -622,6 +791,174 @@ class ServingEngine:
                            slot=slot, prompt_tokens=Tp,
                            cached_tokens=cached, blocks=len(chain))
         self._m_prefill_ms.observe(prefill_ms)
+
+    # -- chunked prefill (the fused mixed tick) -----------------------------
+
+    def _chunked_enter(self, slot: int, req: Request, now: float):
+        """Admit one request into a slot WITHOUT any prefill dispatch:
+        the prompt is queued on the slot state (``pending``) and streams
+        through the next mixed ticks chunk-by-chunk under the
+        scheduler's token budget. Prefix-cache hits still skip the
+        shared span — only the suffix goes through chunks."""
+        Tp = int(req.prompt.size)
+        cached = 0
+        if self.paged:
+            chain, cached = self._paged_attach_blocks(req)
+            tables = self._block_tables.copy()
+            tables[slot, :] = 0
+            tables[slot, :len(chain)] = chain
+            self._block_tables = tables
+            # copy-and-rebind (aliasing hazard, see _decode_tick): the
+            # row starts at the cached span; chunks advance it
+            lens = self._seq_lens.copy()
+            lens[slot] = cached
+            self._seq_lens = lens
+        else:
+            chain = None
+            self._cache = _reset_slot_cursors(self._cache,
+                                              jnp.int32(slot))
+        self._rngs = self._rngs.at[slot].set(jax.random.PRNGKey(req.seed))
+        self._slots[slot] = _SlotState(
+            req=req, remaining=req.max_new_tokens, blocks=chain,
+            cached_tokens=cached,
+            pending=np.asarray(req.prompt[cached:], np.int32),
+            decoding=False, admit_seq=self._admit_seq, admit_t=now,
+        )
+        self._admit_seq += 1
+        self.prompt_tokens += Tp
+        self._m_prompt_tokens.inc(Tp)
+        if self.paged:
+            self.prefix_hit_tokens += cached
+            self._m_prefix_hit.inc(cached)
+
+    def _mixed_tick(self):
+        """One fused mixed prefill/decode tick: deal the token budget
+        (decodes first, then prompt chunks in admission order), run ONE
+        ``[S, C]`` dispatch advancing every row at its own valid
+        length, emit the decoding rows' sampled tokens, flip rows whose
+        last chunk landed to DECODING, and complete/free EOS'd or
+        exhausted rows. When no prefill token was dealt this tick the
+        dispatch shrinks to the plain ``[S, 1]`` decode shape — an
+        all-decode steady state pays exactly the unchunked tick."""
+        S = self.slots
+        cfgs = tuple(
+            (st.req.temperature, st.req.top_k, st.req.top_p)
+            if st else _IDLE_CFG
+            for st in self._slots
+        )
+        n_dec = sum(1 for st in self._slots if st and st.decoding)
+        pre = sorted(
+            ((s, st) for s, st in enumerate(self._slots)
+             if st and not st.decoding),
+            key=lambda p: p[1].admit_seq,
+        )
+        takes = self.scheduler.plan_prefill(
+            n_dec, [len(st.pending) for _, st in pre], self.prefill_chunk
+        )
+        fed_tokens = sum(takes)
+        C = self.prefill_chunk if fed_tokens else 1
+        fed = np.zeros((S, C), np.int32)
+        valid = np.zeros((S,), np.int32)
+        sample_mask = np.zeros((S,), bool)
+        for s, st in enumerate(self._slots):
+            if st is None or st.decoding:
+                # idle rows tick along like decoders (sampling greedily
+                # into the void at their parked cursor, as the unchunked
+                # tick always has)
+                valid[s] = 1
+                sample_mask[s] = True
+        for (s, st), take in zip(pre, takes):
+            if take > 0:
+                fed[s, :take] = st.pending[:take]
+                valid[s] = take
+            # take == 0: starved this tick — valid stays 0, the row
+            # writes nothing and its cursor holds
+        t0 = time.perf_counter()
+        if self.paged:
+            tick = _paged_mixed_tick_fn(self._dm_paged, cfgs, C)
+            self._cache, self._last_logits, toks, self._rngs = tick(
+                self._params_only, self._cache, self._last_logits,
+                self._rngs, jnp.asarray(self._block_tables),
+                jnp.asarray(self._seq_lens), jnp.asarray(fed),
+                jnp.asarray(valid), jnp.asarray(sample_mask),
+            )
+            # REBIND, never mutate (aliasing hazard, see _decode_tick):
+            # live rows advance by what they consumed; idle rows stay
+            # parked at 0 on the trash block
+            adv = np.zeros((S,), np.int32)
+            for s, st in enumerate(self._slots):
+                if st is not None:
+                    adv[s] = 1 if st.decoding else valid[s]
+            self._seq_lens = self._seq_lens + adv
+        else:
+            tick = _mixed_tick_fn(self._dm_slot, cfgs, C)
+            self._cache, self._last_logits, toks, self._rngs = tick(
+                self._params_only, self._cache, self._last_logits,
+                self._rngs, jnp.asarray(fed), jnp.asarray(valid),
+                jnp.asarray(sample_mask),
+            )
+        toks_host = np.asarray(toks)  # forces completion of the tick
+        tick_ms = (time.perf_counter() - t0) * 1e3
+        self.ticks += 1
+        occupancy = sum(st is not None for st in self._slots)
+        self._occ_sum += occupancy
+        now = time.monotonic()
+        emitted = 0
+        for s, st in enumerate(self._slots):
+            if st is None:
+                continue
+            req = st.req
+            if not st.decoding:
+                take = int(valid[s])
+                if take > 0:
+                    st.pending = st.pending[take:]
+                    if st.pending.size == 0:
+                        # last chunk landed: this tick's logits at the
+                        # row's final valid token are the prompt-final
+                        # logits — the NEXT tick samples the first token
+                        st.decoding = True
+                        req.prefill_done_t = now
+                        prefill_ms = (now - st.admit_t) * 1e3
+                        self.tracer.record(
+                            req.trace_id, "prefill", st.admit_t,
+                            prefill_ms, slot=s,
+                            prompt_tokens=int(req.prompt.size),
+                            cached_tokens=st.cached_tokens,
+                            chunk=self.prefill_chunk,
+                        )
+                        self._m_prefill_ms.observe(prefill_ms)
+                continue
+            tok = int(toks_host[s])
+            if req.first_token_t is None:
+                req.first_token_t = now
+                self._m_ttft_ms.observe((now - req.submit_t) * 1e3)
+            else:
+                self._m_itl_ms.observe((now - req.last_token_t) * 1e3)
+            req.last_token_t = now
+            req.stream._put(tok)
+            req.n_emitted += 1
+            st.remaining -= 1
+            self.tokens_generated += 1
+            emitted += 1
+            if req.eos_id is not None and tok == req.eos_id:
+                self._complete(s, "eos")
+            elif st.remaining == 0:
+                self._complete(s, "length")
+        queue_depth = self.scheduler.depth()
+        self._m_ticks.inc()
+        self._m_tokens.inc(emitted)
+        self._m_occupancy.set(sum(st is not None for st in self._slots))
+        self._m_tick_ms.observe(tick_ms)
+        if fed_tokens + n_dec > 0:
+            self._m_prefill_frac.observe(fed_tokens / (fed_tokens + n_dec))
+        if tick_ms > 0:
+            self._m_decode_tps.set(round(emitted / (tick_ms / 1e3), 3))
+        self.metrics.log(
+            step=self.ticks, occupancy=occupancy,
+            queue_depth=queue_depth,
+            token_ms=round(tick_ms, 3),
+            prefill_tokens=fed_tokens,
+        )
 
     def _decode_tick(self):
         cfgs = tuple(
@@ -670,6 +1007,9 @@ class ServingEngine:
                 self._m_ttft_ms.observe(
                     (now - req.submit_t) * 1e3
                 )
+            else:
+                self._m_itl_ms.observe((now - req.last_token_t) * 1e3)
+            req.last_token_t = now
             req.stream._put(tok)
             req.n_emitted += 1
             st.remaining -= 1
@@ -768,6 +1108,14 @@ class ServingEngine:
             ),
             "ttft_ms": self.metrics.percentiles("ttft_ms"),
             "token_ms": self.metrics.percentiles("token_ms"),
+            # bucket-interpolated stream-gap percentiles; None until two
+            # tokens of one stream have been emitted (the registry
+            # histogram keeps the full distribution)
+            "itl_ms": {
+                "p50": self._m_itl_ms.percentile(50),
+                "p99": self._m_itl_ms.percentile(99),
+            },
+            "decode_stalls": self._m_decode_stalls.value,
         }
         if self.paged:
             out.update({

@@ -7,10 +7,19 @@ caller that outruns the engine gets :class:`QueueFullError` immediately
 instead of growing an unbounded backlog), per-request deadlines (a
 request whose deadline passes while it is still queued is expired, never
 prefilled — the slot budget is spent on requests that can still meet
-their SLO), and a prefill/decode interleave cap (at most
-``max_prefills_per_tick`` admissions per engine tick, so a burst of
-arrivals cannot stall the decode latency of the requests already in
-flight behind a wall of prefill passes).
+their SLO), and the Sarathi-style **per-tick token budget**
+(``tick_token_budget``): each engine tick may process at most that many
+*useful* tokens — one is reserved per decoding slot first, and the
+remainder is handed to prefilling slots as prompt chunks
+(:meth:`FIFOScheduler.plan_prefill`) — so a burst of long prompts is
+metered through the ticks instead of stalling every live decode stream
+behind a wall of prefill work.
+
+``max_prefills_per_tick`` (the pre-chunking prefill/decode interleave
+cap — at most N whole-prompt prefill dispatches per tick) is deprecated:
+passing it maps onto an equivalent token budget (N default-sized chunks
+per tick) with a :class:`DeprecationWarning`, and still bounds
+admissions per pop for engines running the legacy monolithic prefill.
 """
 
 from __future__ import annotations
@@ -19,13 +28,19 @@ import itertools
 import queue as _queue
 import threading
 import time
+import warnings
 from collections import deque
 from dataclasses import dataclass, field
-from typing import Callable, List, Optional, Tuple
+from typing import Callable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from distkeras_tpu import telemetry
+
+# the chunk size one deprecated max_prefills_per_tick unit maps onto
+# (also ServingEngine's default prefill_chunk — one legacy "prefill per
+# tick" becomes one default-sized chunk of prefill tokens per tick)
+DEFAULT_PREFILL_CHUNK = 64
 
 
 class QueueFullError(RuntimeError):
@@ -104,6 +119,7 @@ class Request:
     # engine bookkeeping (monotonic timestamps)
     submit_t: Optional[float] = None
     first_token_t: Optional[float] = None
+    last_token_t: Optional[float] = None  # previous emit (ITL histogram)
     done_t: Optional[float] = None
     prefill_done_t: Optional[float] = None
     n_emitted: int = 0
@@ -111,23 +127,59 @@ class Request:
 
 class FIFOScheduler:
     """FIFO admission with bounded depth, queued-deadline expiry, and a
-    per-tick prefill cap. Thread-safe: the TCP front-end submits from
-    handler threads while the engine pops from its loop thread."""
+    Sarathi-style per-tick token budget. Thread-safe: the TCP front-end
+    submits from handler threads while the engine pops from its loop
+    thread.
+
+    Args:
+      max_queue_depth: hard bound on queued requests (backpressure).
+      tick_token_budget: useful tokens one engine tick may process —
+        decoding slots reserve one each, prefilling slots split the
+        remainder as prompt chunks (:meth:`plan_prefill`). Defaults to
+        256.
+      max_prefills_per_tick: DEPRECATED (pre-chunking interleave cap).
+        Still accepted: maps onto ``tick_token_budget = N *
+        DEFAULT_PREFILL_CHUNK`` (one legacy whole-prompt prefill ≈ one
+        default chunk of prefill tokens per tick) and keeps bounding
+        admissions per :meth:`pop_admissible` for engines running the
+        legacy monolithic prefill.
+    """
 
     def __init__(self, max_queue_depth: int = 256,
-                 max_prefills_per_tick: int = 2,
+                 tick_token_budget: Optional[int] = None,
                  tracer: Optional["telemetry.Tracer"] = None,
-                 registry: Optional["telemetry.MetricRegistry"] = None):
+                 registry: Optional["telemetry.MetricRegistry"] = None,
+                 max_prefills_per_tick: Optional[int] = None):
         if max_queue_depth < 1:
             raise ValueError(
                 f"max_queue_depth must be >= 1; got {max_queue_depth}"
             )
-        if max_prefills_per_tick < 1:
+        if max_prefills_per_tick is not None:
+            if max_prefills_per_tick < 1:
+                raise ValueError(
+                    f"max_prefills_per_tick must be >= 1; "
+                    f"got {max_prefills_per_tick}"
+                )
+            warnings.warn(
+                "FIFOScheduler(max_prefills_per_tick=...) is deprecated: "
+                "prefill is chunked and metered by tick_token_budget now. "
+                f"Mapping {max_prefills_per_tick} prefills/tick onto "
+                f"tick_token_budget={max_prefills_per_tick} * "
+                f"{DEFAULT_PREFILL_CHUNK}.",
+                DeprecationWarning, stacklevel=2,
+            )
+            if tick_token_budget is None:
+                tick_token_budget = (max_prefills_per_tick
+                                     * DEFAULT_PREFILL_CHUNK)
+        if tick_token_budget is None:
+            tick_token_budget = 256
+        if tick_token_budget < 1:
             raise ValueError(
-                f"max_prefills_per_tick must be >= 1; "
-                f"got {max_prefills_per_tick}"
+                f"tick_token_budget must be >= 1; got {tick_token_budget}"
             )
         self.max_queue_depth = max_queue_depth
+        self.tick_token_budget = tick_token_budget
+        # legacy admissions-per-pop cap; None = free slots only
         self.max_prefills_per_tick = max_prefills_per_tick
         self._q: deque = deque()
         self._lock = threading.Lock()
@@ -185,20 +237,25 @@ class FIFOScheduler:
         self, free_slots: int,
         admissible: Optional[Callable[[Request], bool]] = None,
     ) -> Tuple[List[Request], List[Request]]:
-        """Pop up to ``min(free_slots, max_prefills_per_tick)`` requests
-        in FIFO order, expiring deadline-passed ones along the way.
-        ``admissible`` is an optional resource gate (the paged engine's
-        free-block check): when the HEAD request fails it, popping stops
-        — FIFO order is preserved (no queue-jumping past a request that
-        is merely waiting for blocks), and the head retries next step.
-        Returns ``(admitted, expired)``; expired requests are already
-        finished here — span chain (``queued`` → ``finish`` with
-        ``reason="expired"``), finish-reason counter, and the stream's
-        end sentinel — so they show up in trace dumps even if the
-        caller drops them."""
+        """Pop up to ``free_slots`` requests in FIFO order, expiring
+        deadline-passed ones along the way (chunked engines meter the
+        admitted prompts through :meth:`plan_prefill`, so admission
+        itself costs no prefill dispatch; a deprecated
+        ``max_prefills_per_tick`` still caps the pop for legacy
+        monolithic-prefill engines). ``admissible`` is an optional
+        resource gate (the paged engine's free-block check): when the
+        HEAD request fails it, popping stops — FIFO order is preserved
+        (no queue-jumping past a request that is merely waiting for
+        blocks), and the head retries next step. Returns ``(admitted,
+        expired)``; expired requests are already finished here — span
+        chain (``queued`` → ``finish`` with ``reason="expired"``),
+        finish-reason counter, and the stream's end sentinel — so they
+        show up in trace dumps even if the caller drops them."""
         admitted: List[Request] = []
         expired: List[Request] = []
-        budget = min(free_slots, self.max_prefills_per_tick)
+        budget = free_slots
+        if self.max_prefills_per_tick is not None:
+            budget = min(budget, self.max_prefills_per_tick)
         now = time.monotonic()
         with self._lock:
             while self._q and len(admitted) < budget:
@@ -216,6 +273,25 @@ class FIFOScheduler:
         if admitted or expired:
             self._m_depth.set(depth)
         return admitted, expired
+
+    def plan_prefill(self, n_decoding: int, pending_lens: Sequence[int],
+                     chunk: int) -> List[int]:
+        """Sarathi-style budget split for ONE mixed tick: every decoding
+        slot reserves one budget token first (decode never stalls behind
+        prefill), then the remainder is dealt to prefilling slots in
+        admission order — each gets ``min(chunk, its remaining prompt,
+        budget left)`` tokens, possibly 0 (that slot simply makes no
+        prefill progress this tick and retries next tick; starvation is
+        bounded because decoding slots drain at max_new_tokens and free
+        their reservations). Returns one token count per entry of
+        ``pending_lens``."""
+        remain = max(self.tick_token_budget - n_decoding, 0)
+        out: List[int] = []
+        for n in pending_lens:
+            take = min(chunk, int(n), remain)
+            out.append(take)
+            remain -= take
+        return out
 
     def _expire(self, req: Request):
         """Finish a queued request whose deadline passed before a slot

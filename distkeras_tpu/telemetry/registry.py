@@ -232,7 +232,11 @@ class Histogram(_Metric):
     def percentile(self, p: float, **labels) -> Optional[float]:
         """Bucket-interpolated percentile estimate (the exact-value
         percentiles stay with MetricsWriter; this is the scrape-side
-        approximation). None until something was observed."""
+        approximation). None until something was observed, and None
+        when every observation fell outside the bucket range (all in
+        +Inf — e.g. NaN or beyond the last bound): there is no finite
+        bucket to interpolate in, and callers like serve_bench's ITL
+        report key on None, not a fabricated bound."""
         key = (tuple(str(labels[n]) for n in self.labelnames)
                if labels else self._unlabeled())
         with self._lock:
@@ -240,6 +244,8 @@ class Histogram(_Metric):
             if state is None or state[2] == 0:
                 return None
             counts, _, n = [list(state[0]), state[1], state[2]]
+        if sum(counts[:-1]) == 0:  # nothing landed in a finite bucket
+            return None
         rank = n * p / 100.0
         cum = 0
         lo = 0.0

@@ -9,6 +9,7 @@ any downstream tool can consume them.
 from __future__ import annotations
 
 import json
+import math
 import os
 import threading
 import time
@@ -60,10 +61,15 @@ class MetricsWriter:
         """p50/p90/p99 (linear interpolation, numpy convention) over
         every logged record carrying ``key`` — the serving engine and
         serve_bench both report their TTFT / per-token latency
-        distributions through this. None when nothing logged ``key``."""
+        distributions through this. None when nothing logged ``key``,
+        and None when every logged value is non-finite (NaN/inf would
+        otherwise poison the sort and return NaN percentiles — the
+        serving ITL report depends on None for scenarios that produced
+        no decode ticks)."""
         with self._lock:
             vals = sorted(
-                float(r[key]) for r in self._records if key in r
+                v for r in self._records if key in r
+                for v in (float(r[key]),) if math.isfinite(v)
             )
         if not vals:
             return None

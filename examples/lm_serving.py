@@ -18,8 +18,13 @@ system prefix, so every request after the first skips most of its
 prefill (the printed stats show the prefix-hit fraction and block
 usage). Streams are bit-identical either way.
 
+Prompts stream into their slots chunk-by-chunk inside the decode tick
+(Sarathi-style chunked prefill; ``--prefill-chunk`` sets the chunk, 0
+restores the legacy monolithic whole-prompt prefill dispatch) — a long
+prompt never stalls the tokens already streaming.
+
 Run: python examples/lm_serving.py [--prompts 4] [--max-new 16] [--slots 2]
-     [--telemetry-port 9100] [--paged]
+     [--telemetry-port 9100] [--paged] [--prefill-chunk 16]
 """
 
 import argparse
@@ -52,6 +57,11 @@ def main():
                     help="block-paged KV cache + radix prefix sharing "
                          "(prompts share a system prefix; repeat "
                          "requests skip its prefill)")
+    ap.add_argument("--prefill-chunk", type=int, default=None,
+                    help="chunked mixed-tick prefill: prompts stream "
+                         "into their slot this many tokens per decode "
+                         "tick (0 = legacy monolithic prefill; default "
+                         "64)")
     args = ap.parse_args()
 
     model = get_model(
@@ -84,13 +94,16 @@ def main():
         ]
 
     engine_kw = {}
+    if args.prefill_chunk is not None:
+        engine_kw["prefill_chunk"] = (None if args.prefill_chunk == 0
+                                      else args.prefill_chunk)
     if args.paged:
         # largest small block size dividing max_len (paged mode needs
         # whole blocks); small blocks keep sharing visible on tiny
         # prompts
         max_len = args.prompt_len + args.max_new
         bs = next(b for b in (8, 4, 2, 1) if max_len % b == 0)
-        engine_kw = dict(paged=True, block_size=bs)
+        engine_kw.update(paged=True, block_size=bs)
     engine = ServingEngine(model, params, slots=args.slots, **engine_kw)
     server = LMServer(engine).start()
     telemetry_server = None

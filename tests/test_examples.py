@@ -190,6 +190,22 @@ def test_lm_serving_example_paged_smoke(monkeypatch, capsys):
     assert "prefix hit fraction" in out
 
 
+def test_lm_serving_example_prefill_chunk_smoke(monkeypatch, capsys):
+    """--prefill-chunk: prompts stream through mixed ticks in tiny
+    chunks (smaller than the prompt, so multiple chunk ticks per
+    request) — streams stay parity-exact."""
+    sys.path.insert(0, "examples")
+    run_example(
+        monkeypatch, "lm_serving",
+        ["lm_serving.py", "--prompts", "3", "--max-new", "8",
+         "--slots", "2", "--prompt-len", "6", "--vocab", "64",
+         "--prefill-chunk", "2"],
+    )
+    out = capsys.readouterr().out
+    assert out.count("parity OK") == 3
+    assert "served 3 requests" in out
+
+
 def test_lm_training_text_mode_smoke(monkeypatch, capsys, tmp_path):
     """--text end-to-end on a tiny corpus: byte-tokenize, train with the
     cosine schedule, report held-out perplexity, print a decoded

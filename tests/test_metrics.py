@@ -33,6 +33,21 @@ def test_percentiles():
     assert w2.percentiles("ttft_ms", ps=(50,)) == {"p50": 20.0}
 
 
+def test_percentiles_none_on_all_nonfinite():
+    """Satellite: NaN/inf values must not poison the sort into NaN
+    percentiles — they are filtered, and a key whose every value is
+    non-finite reports None (serve_bench's ITL report keys on None for
+    scenarios that produced no decode ticks)."""
+    w = MetricsWriter()
+    w.log(step=0, itl_ms=float("nan"))
+    w.log(step=1, itl_ms=float("inf"))
+    assert w.percentiles("itl_ms") is None
+    # finite values still count once any exist
+    w.log(step=2, itl_ms=5.0)
+    w.log(step=3, itl_ms=7.0)
+    assert w.percentiles("itl_ms", ps=(50,)) == {"p50": 6.0}
+
+
 def test_staleness_histogram():
     assert staleness_histogram([0, 0, 1, 3, 1, 0]) == {0: 3, 1: 2, 3: 1}
     assert staleness_histogram([]) == {}

@@ -103,9 +103,11 @@ def test_parity_with_eos_gqa_int8_rope():
 
 def test_eos_frees_slot_same_tick():
     """When a request samples its eos, its slot is refilled from the
-    queue in the same step() call — the replacement decodes on the very
-    next tick, so the tick count for two back-to-back requests is the
-    sum of their stream lengths with no idle tick between."""
+    queue in the same step() call — the replacement's prompt chunk rides
+    the very next tick, so the tick count for two back-to-back requests
+    is the sum of their stream lengths plus exactly one prefill-chunk
+    tick each (both prompts fit one default chunk), with no idle tick
+    between."""
     model, params = _model_and_params()
     rng = np.random.default_rng(2)
     p1, p2 = (rng.integers(0, 64, size=6).astype(np.int32)
@@ -122,19 +124,21 @@ def test_eos_frees_slot_same_tick():
     saw_refill_tick = None
     while eng.step():
         if saw_refill_tick is None and r1.done_t is not None:
-            # the step that completed r1 must already have prefilled r2
+            # the step that completed r1 must already have admitted r2
             saw_refill_tick = eng.ticks
             assert eng.slot_requests == [r2.rid]
-    assert saw_refill_tick == len(want1)
+    # r1: 1 chunk tick + 4 decode ticks, eos on the 5th
+    assert saw_refill_tick == 1 + len(want1)
     assert r1.stream.tokens(timeout=10) == want1
     assert r2.stream.tokens(timeout=10) == want2
-    # no idle ticks: every tick emitted a token for exactly one request
-    assert eng.ticks == len(want1) + len(want2)
+    # no idle ticks: every tick either fed a prompt chunk or emitted a
+    # token for exactly one request
+    assert eng.ticks == (1 + len(want1)) + (1 + len(want2))
 
 
 def test_queue_backpressure_and_deadline():
     model, params = _model_and_params()
-    sched = FIFOScheduler(max_queue_depth=2, max_prefills_per_tick=1)
+    sched = FIFOScheduler(max_queue_depth=2, tick_token_budget=64)
     eng = ServingEngine(model, params, slots=1, scheduler=sched)
     p = np.zeros(4, np.int32)
     eng.submit(p, max_new_tokens=2)

@@ -84,6 +84,14 @@ def test_histogram_buckets_and_percentile():
     assert 1.0 <= h.percentile(50) <= 10.0
     assert h.percentile(99) == 100.0  # +Inf clamps to the last bound
     assert reg.histogram("empty", buckets=(1.0,)).percentile(50) is None
+    # satellite: every observation out of bucket range (all in +Inf,
+    # e.g. NaN or beyond the last bound) -> None, not a fabricated
+    # bound and not NaN — serve_bench's ITL report keys on None
+    oob = reg.histogram("oob", buckets=(1.0, 10.0))
+    oob.observe(500.0)
+    oob.observe(float("nan"))
+    assert oob.percentile(50) is None
+    assert oob.value["count"] == 2  # the observations still counted
 
 
 def test_histogram_thread_safety():
