@@ -110,6 +110,9 @@ def bench(V=1024, D=256, H=4, L=4, slots=8, n_requests=48, prompt_len=16,
     registry = telemetry.MetricRegistry()
     engine = ServingEngine(model, params, slots=slots, metrics=metrics,
                            registry=registry)
+    # warmup is done (the throwaway engine above traced every shape this
+    # run uses); from here any jit re-trace is a steady-state recompile
+    engine.mark_steady()
     stop = threading.Event()
     loop = threading.Thread(target=engine.serve_forever, args=(stop,),
                             daemon=True)
@@ -151,6 +154,12 @@ def bench(V=1024, D=256, H=4, L=4, slots=8, n_requests=48, prompt_len=16,
         "ttft_hist": ttft_hist,
         "token_ms_hist": token_hist,
         "mean_occupancy": stats["mean_occupancy"],
+        # runtime introspection (PR 5): flight-recorder cost as a
+        # fraction of tick wall time, jit re-traces after warmup
+        # (nonempty = steady-state recompile bug), memory watermarks
+        "flight_overhead_frac": stats["flight"]["overhead_frac"],
+        "steady_recompiles": stats["recompiles_since_mark"],
+        "memory": stats["memory"],
         "config": f"d{D}/h{H}/L{L}/v{V}-slots{slots}-req{n_requests}"
                   f"-prompt{prompt_len}-poisson{mean_interarrival_s}"
                   f"-mixed8to48-{dtype}",
@@ -232,6 +241,7 @@ def bench_shared_prefix(V=1024, D=256, H=4, L=4, slots=8, n_requests=16,
             block_size=block_size, prefix_cache=prefix_cache,
             registry=registry, tracer=telemetry.Tracer(),
         )
+        engine.mark_steady()  # warm_eng traced every shape this run uses
         t0 = time.perf_counter()
         tokens = 0
         for p in trace:
@@ -258,6 +268,9 @@ def bench_shared_prefix(V=1024, D=256, H=4, L=4, slots=8, n_requests=16,
             "serving_block_evictions_total").value,
         "tokens_per_sec": round(tokens_hit / dt_hit, 1),
         "tokens_per_sec_no_cache": round(tokens_cold / dt_cold, 1),
+        "flight_overhead_frac": s_hit["flight"]["overhead_frac"],
+        "steady_recompiles": s_hit["recompiles_since_mark"],
+        "memory": s_hit["memory"],
         "config": f"d{D}/h{H}/L{L}/v{V}-slots{slots}-req{n_requests}"
                   f"-prefix{prefix_len}+{tail_len}-new{max_new}"
                   f"-bs{block_size}-{dtype}"
@@ -272,6 +285,11 @@ def bench_shared_prefix(V=1024, D=256, H=4, L=4, slots=8, n_requests=16,
         )
         assert "serving_blocks_in_use" in exposition
         assert tokens_hit == tokens_cold == n_requests * max_new
+        # runtime-introspection guards: warmup traced every shape, so a
+        # steady-state jit re-trace is a latency bug; the flight
+        # recorder must cost <5% of tick wall time
+        assert result["steady_recompiles"] == {}, result
+        assert result["flight_overhead_frac"] < 0.05, result
     print(json.dumps(result), flush=True)
     return result
 
@@ -374,6 +392,7 @@ def bench_long_prompt_interference(
                                     registry=telemetry.MetricRegistry(),
                                     tracer=telemetry.Tracer()),
         )
+        engine.mark_steady()  # warm engine traced every shape used here
         stop = threading.Event()
         loop = threading.Thread(target=engine.serve_forever, args=(stop,),
                                 daemon=True)
@@ -463,6 +482,7 @@ def bench_long_prompt_interference(
             total = tokens[0]
         p50 = vals[int(0.50 * (len(vals) - 1))] if vals else None
         p99 = vals[int(0.99 * (len(vals) - 1))] if vals else None
+        est = engine.stats()
         return {
             "itl_ms_p50": p50, "itl_ms_p99": p99,
             "itl_ms_max": vals[-1] if vals else None,
@@ -471,6 +491,9 @@ def bench_long_prompt_interference(
             "itl_hist": registry.histogram("serving_itl_ms").value,
             "decode_stalls": registry.counter(
                 "serving_decode_stalls_total").value,
+            "steady_recompiles": est["recompiles_since_mark"],
+            "flight_overhead_frac": est["flight"]["overhead_frac"],
+            "memory": est["memory"],
             "streams": streams,
         }
 
@@ -502,6 +525,11 @@ def bench_long_prompt_interference(
         "monolithic_tokens_per_sec": mono["tokens_per_sec"],
         "monolithic_decode_stalls": mono["decode_stalls"],
         "chunked_decode_stalls": chunked["decode_stalls"],
+        "chunked_steady_recompiles": chunked["steady_recompiles"],
+        "monolithic_steady_recompiles": mono["steady_recompiles"],
+        "chunked_flight_overhead_frac": chunked["flight_overhead_frac"],
+        "monolithic_flight_overhead_frac": mono["flight_overhead_frac"],
+        "memory": chunked["memory"],
         "chunked_itl_samples": chunked["itl_samples"],
         "monolithic_itl_samples": mono["itl_samples"],
         "chunked_itl_hist": chunked["itl_hist"],
@@ -521,6 +549,13 @@ def bench_long_prompt_interference(
         assert mono["decode_stalls"] > 0, result
         assert chunked["decode_stalls"] == 0, result
         assert chunked["itl_ms_p99"] < mono["itl_ms_p99"], result
+        # runtime-introspection guards (PR 5): a steady-state jit
+        # re-trace after warmup is a latency bug in either mode, and
+        # the always-on flight recorder must stay under 5% of tick time
+        assert chunked["steady_recompiles"] == {}, result
+        assert mono["steady_recompiles"] == {}, result
+        assert chunked["flight_overhead_frac"] < 0.05, result
+        assert mono["flight_overhead_frac"] < 0.05, result
     print(json.dumps(result), flush=True)
     return result
 

@@ -56,13 +56,19 @@ TTFT, per-token latency, per-stream inter-token latency
 msgpack ``stats``/``trace_dump`` ops and the HTTP endpoint. The
 per-tick/per-request JSONL records still ride
 :class:`~distkeras_tpu.utils.metrics.MetricsWriter` for offline
-analysis. All instrumentation is host-side bookkeeping around the jitted
-calls — token streams stay bit-identical to solo ``generate()``.
+analysis. The engine also keeps a black box: a per-tick
+:class:`~distkeras_tpu.telemetry.FlightRecorder` snapshot (slot states,
+budget split, phase-decomposed latency) dumped to a postmortem JSONL on
+crash or stall, plus runtime introspection — jit recompile counting
+inside the traced bodies and RSS/device-memory watermark gauges. All
+instrumentation is host-side bookkeeping around the jitted calls —
+token streams stay bit-identical to solo ``generate()``.
 """
 
 from __future__ import annotations
 
 import functools
+import sys
 import threading
 import time
 from dataclasses import dataclass
@@ -74,6 +80,9 @@ import numpy as np
 
 from distkeras_tpu import telemetry
 from distkeras_tpu.models.transformer import sample_tokens
+from distkeras_tpu.telemetry.flight import FlightRecorder
+from distkeras_tpu.telemetry.runtime import MemoryWatermarks, recompiles
+from distkeras_tpu.telemetry.slo import StallWatchdog
 from distkeras_tpu.serving.kvpool import BlockPool
 from distkeras_tpu.serving.prefix import RadixPrefixIndex
 from distkeras_tpu.serving.scheduler import (
@@ -94,6 +103,7 @@ def _prefill_fn(dm_one):
 
     @functools.partial(jax.jit, donate_argnums=(1, 2))
     def prefill(params_only, pooled, last_logits, prompt, slot):
+        recompiles.note("serve.prefill")
         cache1 = jax.tree.map(
             lambda s: jnp.zeros(s.shape, s.dtype),
             jax.eval_shape(
@@ -133,6 +143,7 @@ def _tick_fn(dm_slot, cfgs):
 
     @functools.partial(jax.jit, donate_argnums=(1, 2, 3))
     def tick(params_only, cache, last_logits, rngs):
+        recompiles.note("serve.tick")
         toks, new_rngs = [], []
         for s, (temp, top_k, top_p) in enumerate(cfgs):
             rng, sub = jax.random.split(rngs[s])
@@ -163,6 +174,7 @@ def _paged_prefill_fn(dm_paged):
     @functools.partial(jax.jit, donate_argnums=(1, 2))
     def prefill(params_only, cache, last_logits, suffix, table, start,
                 slot):
+        recompiles.note("serve.paged_prefill")
         logits, vs = dm_paged.apply(
             {**params_only, "cache": cache}, suffix,
             block_tables=table, seq_lens=start, mutable=["cache"],
@@ -192,6 +204,7 @@ def _mixed_tick_fn(dm_slot, cfgs, chunk):
     @functools.partial(jax.jit, donate_argnums=(1, 2, 3))
     def tick(params_only, cache, last_logits, rngs, fed, valid,
              sample_mask):
+        recompiles.note("serve.mixed_tick")
         toks, new_rngs = [], []
         for s, (temp, top_k, top_p) in enumerate(cfgs):
             rng, sub = jax.random.split(rngs[s])
@@ -228,6 +241,7 @@ def _paged_mixed_tick_fn(dm_paged, cfgs, chunk):
     @functools.partial(jax.jit, donate_argnums=(1, 2, 3))
     def tick(params_only, cache, last_logits, rngs, tables, lens, fed,
              valid, sample_mask):
+        recompiles.note("serve.paged_mixed_tick")
         toks, new_rngs = [], []
         for s, (temp, top_k, top_p) in enumerate(cfgs):
             rng, sub = jax.random.split(rngs[s])
@@ -261,6 +275,7 @@ def _reset_slot_cursors(cache, slot):
     its own chunks before any query can reach it (causal mask at the
     row's own cursor), so stale bytes beyond the cursor are
     unreachable."""
+    recompiles.note("serve.reset_cursors")
     return jax.tree.map(
         lambda c: c.at[slot].set(0) if c.ndim == 1 else c, cache
     )
@@ -274,6 +289,7 @@ def _paged_tick_fn(dm_paged, cfgs):
 
     @functools.partial(jax.jit, donate_argnums=(1, 2, 3))
     def tick(params_only, cache, last_logits, rngs, tables, lens):
+        recompiles.note("serve.paged_tick")
         toks, new_rngs = [], []
         for s, (temp, top_k, top_p) in enumerate(cfgs):
             rng, sub = jax.random.split(rngs[s])
@@ -298,6 +314,7 @@ def _copy_block(cache, src, dst):
     across every paged cache leaf (K, V, int8 scales — all block-major),
     so a sequence that diverges mid-block writes into its own copy and
     the shared original stays immutable."""
+    recompiles.note("serve.copy_block")
     return jax.tree.map(lambda c: c.at[dst].set(c[src]), cache)
 
 
@@ -367,6 +384,16 @@ class ServingEngine:
         restores the legacy monolithic whole-prompt B=1 prefill
         dispatch (kept as the bench baseline). Streams are
         bit-identical either way, at any chunk size.
+      flight: the black box. ``True`` (default) records one structured
+        snapshot per tick (slot states, queue depth, budget split,
+        phase-decomposed latency) into a fresh bounded
+        :class:`~distkeras_tpu.telemetry.FlightRecorder`; pass a
+        recorder to share one, or ``None`` to disable. A crash inside
+        :meth:`step` (and a :meth:`watchdog` stall) dumps it to a
+        postmortem JSONL that ``report --flight`` renders.
+      flight_capacity: ring size in ticks for the engine-owned recorder.
+      postmortem_dir: where crash/stall dumps land (default ``/tmp``,
+        the path CI uploads on tier-1 failure).
 
     Drive it with :meth:`step` (one admit→tick→complete→refill cycle,
     e.g. from a test) or :meth:`serve_forever` (the TCP front-end's
@@ -383,7 +410,9 @@ class ServingEngine:
                  paged: bool = False, block_size: int = 16,
                  num_blocks: Optional[int] = None,
                  prefix_cache: bool = True,
-                 prefill_chunk: Optional[int] = DEFAULT_PREFILL_CHUNK):
+                 prefill_chunk: Optional[int] = DEFAULT_PREFILL_CHUNK,
+                 flight=True, flight_capacity: int = 512,
+                 postmortem_dir: str = "/tmp"):
         if slots < 1:
             raise ValueError(f"slots must be >= 1; got {slots}")
         if prefill_chunk is not None and prefill_chunk < 1:
@@ -393,6 +422,21 @@ class ServingEngine:
             )
         self.prefill_chunk = prefill_chunk
         self._admit_seq = 0
+        # flight recorder: True = own recorder (the default — its
+        # self-measured overhead is reported in stats()["flight"] and
+        # bounded by serve_bench's smoke assert), a FlightRecorder to
+        # share one, or None/False to disable
+        if flight is True:
+            self.flight: Optional[FlightRecorder] = FlightRecorder(
+                capacity=flight_capacity, postmortem_dir=postmortem_dir
+            )
+        else:
+            self.flight = flight or None
+        self._mem = MemoryWatermarks()
+        self._device = jax.local_devices()[0]
+        self._recompile_mark = recompiles.mark()
+        self._flight_ns = 0  # time spent building/recording snapshots
+        self._tick_ns = 0    # total tick wall time (plan+device+stream)
         self.model = (model if max_len is None
                       else model.clone(max_len=max_len, parent=None))
         self.slots = slots
@@ -525,6 +569,27 @@ class ServingEngine:
         self._m_prompt_tokens = reg.counter(
             "serving_prompt_tokens_total",
             "prompt tokens across admitted requests (hit + prefilled)")
+        # runtime introspection (PR 5): recompiles are process-global
+        # (jit trace caches are), so the gauge mirrors the shared
+        # counter; memory gauges are sampled every few ticks
+        self._m_recompiles = reg.gauge(
+            "jax_recompiles",
+            "process-total jit traces of the serving tick/prefill "
+            "functions (steady-state growth is a bug)")
+        self._m_rss = reg.gauge(
+            "process_rss_bytes", "host resident set size")
+        self._m_device_mem = reg.gauge(
+            "device_bytes_in_use",
+            "device allocator bytes in use (backends with memory_stats)")
+        self._m_device_peak = reg.gauge(
+            "device_peak_bytes_in_use",
+            "device allocator high-water mark")
+        self._m_oldest_wait = reg.gauge(
+            "serving_queue_oldest_wait_s",
+            "age of the oldest queued request (admission latency SLO)")
+        self._m_crashes = reg.counter(
+            "serving_engine_crashes_total",
+            "exceptions escaping step() (each dumps a flight postmortem)")
 
     # -- submission ---------------------------------------------------------
 
@@ -573,7 +638,30 @@ class ServingEngine:
         over the pool (mixed prefill/decode when chunked), emit tokens,
         free finished slots, and refill them from the queue (same call —
         the freed slot never idles a tick). Returns False when there is
-        nothing to do."""
+        nothing to do.
+
+        An exception escaping the cycle dumps the flight recorder to a
+        postmortem JSONL (``report --flight`` renders it) before
+        re-raising — the crash takes the engine down with its last
+        ``flight_capacity`` ticks of state on disk, not in the void."""
+        try:
+            return self._step()
+        except Exception as e:
+            self._m_crashes.inc()
+            if self.flight is not None:
+                path = self.flight.dump_postmortem(
+                    "crash", error=f"{type(e).__name__}: {e}",
+                    tick=self.ticks,
+                )
+                if path:
+                    print(
+                        f"ServingEngine: step() crashed at tick "
+                        f"{self.ticks}; flight postmortem: {path}",
+                        file=sys.stderr,
+                    )
+            raise
+
+    def _step(self) -> bool:
         n_prefills = self._admit()
         occupied = any(st is not None for st in self._slots)
         if occupied:
@@ -606,6 +694,37 @@ class ServingEngine:
         while self.step():
             if time.monotonic() > deadline:
                 raise TimeoutError("engine did not drain in time")
+
+    def watchdog(self, timeout_s: float = 30.0,
+                 interval_s: Optional[float] = None) -> StallWatchdog:
+        """A :class:`StallWatchdog` wired to this engine: when the tick
+        counter stops advancing for ``timeout_s`` while work is pending
+        (occupied slots or queued requests), it dumps a flight
+        postmortem — the failure mode threshold alerts can't see,
+        because a wedged engine updates no metric. The caller owns the
+        lifecycle (``.start()`` / ``.stop()``); :class:`LMServer` does
+        this when given ``watchdog_timeout_s``."""
+        return StallWatchdog(
+            progress=lambda: self.ticks,
+            busy=lambda: (any(st is not None for st in self._slots)
+                          or self.scheduler.depth() > 0),
+            timeout_s=timeout_s, interval_s=interval_s,
+            flight=self.flight, registry=self.registry,
+            tracer=self.tracer,
+        )
+
+    def mark_steady(self):
+        """Declare warmup over: snapshot the process-global recompile
+        counts. Any nonzero :meth:`recompiles_since_mark` afterwards
+        means a jitted serving function re-traced in steady state — a
+        latency bug (``serve_bench --smoke`` asserts the dict is
+        empty)."""
+        self._recompile_mark = recompiles.mark()
+
+    def recompiles_since_mark(self) -> dict:
+        """Per-function jit traces since :meth:`mark_steady` (or engine
+        construction). Empty dict = clean steady state."""
+        return recompiles.since(self._recompile_mark)
 
     # -- internals ----------------------------------------------------------
 
@@ -840,6 +959,7 @@ class ServingEngine:
         exhausted rows. When no prefill token was dealt this tick the
         dispatch shrinks to the plain ``[S, 1]`` decode shape — an
         all-decode steady state pays exactly the unchunked tick."""
+        t_plan0 = time.perf_counter()
         S = self.slots
         cfgs = tuple(
             (st.req.temperature, st.req.top_k, st.req.top_p)
@@ -874,6 +994,7 @@ class ServingEngine:
             # take == 0: starved this tick — valid stays 0, the row
             # writes nothing and its cursor holds
         t0 = time.perf_counter()
+        plan_ms = (t0 - t_plan0) * 1e3
         if self.paged:
             tick = _paged_mixed_tick_fn(self._dm_paged, cfgs, C)
             self._cache, self._last_logits, toks, self._rngs = tick(
@@ -899,6 +1020,7 @@ class ServingEngine:
             )
         toks_host = np.asarray(toks)  # forces completion of the tick
         tick_ms = (time.perf_counter() - t0) * 1e3
+        t_stream0 = time.perf_counter()
         self.ticks += 1
         occupancy = sum(st is not None for st in self._slots)
         self._occ_sum += occupancy
@@ -959,14 +1081,23 @@ class ServingEngine:
             token_ms=round(tick_ms, 3),
             prefill_tokens=fed_tokens,
         )
+        self._record_tick(
+            plan_ms=plan_ms, device_ms=tick_ms,
+            stream_ms=(time.perf_counter() - t_stream0) * 1e3,
+            n_dec=n_dec, prefill_tokens=fed_tokens, chunk=C,
+            emitted=emitted, occupancy=occupancy,
+            queue_depth=queue_depth,
+        )
 
     def _decode_tick(self):
+        t_plan0 = time.perf_counter()
         cfgs = tuple(
             (st.req.temperature, st.req.top_k, st.req.top_p)
             if st else _IDLE_CFG
             for st in self._slots
         )
         t0 = time.perf_counter()
+        plan_ms = (t0 - t_plan0) * 1e3
         if self.paged:
             tick = _paged_tick_fn(self._dm_paged, cfgs)
             self._cache, self._last_logits, toks, self._rngs = tick(
@@ -991,6 +1122,7 @@ class ServingEngine:
             )
         toks_host = np.asarray(toks)  # forces completion of the tick
         tick_ms = (time.perf_counter() - t0) * 1e3
+        t_stream0 = time.perf_counter()
         self.ticks += 1
         occupancy = sum(st is not None for st in self._slots)
         self._occ_sum += occupancy
@@ -1030,6 +1162,13 @@ class ServingEngine:
             step=self.ticks, occupancy=occupancy,
             queue_depth=queue_depth,
             token_ms=round(tick_ms, 3),
+        )
+        self._record_tick(
+            plan_ms=plan_ms, device_ms=tick_ms,
+            stream_ms=(time.perf_counter() - t_stream0) * 1e3,
+            n_dec=occupancy, prefill_tokens=0, chunk=None,
+            emitted=emitted, occupancy=occupancy,
+            queue_depth=queue_depth,
         )
 
     def _complete(self, slot: int, reason: str):
@@ -1093,6 +1232,101 @@ class ServingEngine:
 
     # -- observability ------------------------------------------------------
 
+    MEM_SAMPLE_EVERY = 32  # ticks between /proc + device-allocator reads
+
+    def _slot_snaps(self) -> list:
+        """Per-slot state for the flight snapshot: None (idle) or a
+        small dict — rid, state, tokens left to emit (decode) or prompt
+        tokens still pending (prefill)."""
+        out = []
+        for st in self._slots:
+            if st is None:
+                out.append(None)
+            elif st.decoding:
+                out.append({"rid": st.req.rid, "state": "decode",
+                            "remaining": st.remaining})
+            else:
+                out.append({"rid": st.req.rid, "state": "prefill",
+                            "pending": int(st.pending.size),
+                            "remaining": st.remaining})
+        return out
+
+    def _sample_memory(self) -> dict:
+        """Host RSS + device allocator watermarks into gauges; returns
+        the plain-dict summary for the flight snapshot. Backends
+        without ``memory_stats()`` (CPU returns None) are probed once
+        and then skipped."""
+        rss = self._mem.sample_host()
+        if rss is not None:
+            self._m_rss.set(rss)
+        if self._mem.device_supported is not False:
+            try:
+                dstats = self._device.memory_stats()
+            except Exception:
+                dstats = None
+            self._mem.sample_device(dstats)
+            if self._mem.device_supported:
+                if self._mem.device_bytes is not None:
+                    self._m_device_mem.set(self._mem.device_bytes)
+                self._m_device_peak.set(self._mem.device_peak_bytes)
+        return self._mem.summary()
+
+    def _record_tick(self, *, plan_ms: float, device_ms: float,
+                     stream_ms: float, n_dec: int, prefill_tokens: int,
+                     chunk: Optional[int], emitted: int, occupancy: int,
+                     queue_depth: int):
+        """Post-tick runtime introspection + the flight snapshot. The
+        whole call is self-timed against tick wall time —
+        ``stats()["flight"]["overhead_frac"]`` is that ratio, and
+        ``serve_bench --smoke`` asserts it stays under 5%."""
+        self._tick_ns += int((plan_ms + device_ms + stream_ms) * 1e6)
+        # runtime introspection runs with or without a recorder (the
+        # gauges are its output); only the snapshot build + ring append
+        # below counts as flight-recorder overhead
+        rec_total = recompiles.total()
+        oldest = self.scheduler.oldest_age_s()
+        sample_tick = self.ticks % self.MEM_SAMPLE_EVERY == 1
+        if sample_tick:
+            # gauge refreshes ride the slow cadence: SLO polls are
+            # ~1 s apart and ticks are ~ms, so a 32-tick-stale gauge
+            # is fresh to every scraper — and the hot path stays lean
+            mem = self._sample_memory()
+            self._m_recompiles.set(rec_total)
+            self._m_oldest_wait.set(round(oldest, 3))
+        else:
+            mem = None
+        t0 = time.perf_counter_ns()
+        if self.flight is not None:
+            # one flat dict, no rounding: this runs every tick and the
+            # smoke bound is 5% of a ~1 ms CPU tick — formatting is the
+            # renderer's job, not the hot path's
+            snap = {
+                "kind": "tick", "tick": self.ticks,
+                "t": time.monotonic(),
+                "tick_ms": plan_ms + device_ms + stream_ms,
+                "plan_ms": plan_ms, "device_ms": device_ms,
+                "stream_ms": stream_ms,
+                "occupancy": occupancy, "queue_depth": queue_depth,
+                "queue_oldest_wait_s": oldest,
+                "budget_limit": self.scheduler.tick_token_budget,
+                "decode_tokens": n_dec,
+                "prefill_tokens": prefill_tokens, "chunk": chunk,
+                "emitted": emitted,
+                "slots": self._slot_snaps(),
+                "recompiles": rec_total,
+            }
+            if mem is not None:
+                snap["mem"] = mem
+            if self.paged:
+                # cheap counts every tick; the live/cached refcount
+                # decomposition only on sample ticks (numpy scan)
+                snap["blocks"] = (self.pool.stats() if sample_tick
+                                  else {"in_use": self.pool.in_use_count(),
+                                        "free": self.pool.free_count()})
+                snap["prefix_hit_tokens"] = self.prefix_hit_tokens
+            self.flight.record(snap)
+        self._flight_ns += time.perf_counter_ns() - t0
+
     def stats(self) -> dict:
         """Counters + latency percentiles (TTFT and per-token, ms) for
         THIS engine. The process-cumulative view (histograms, labeled
@@ -1116,7 +1350,23 @@ class ServingEngine:
                 "p99": self._m_itl_ms.percentile(99),
             },
             "decode_stalls": self._m_decode_stalls.value,
+            "queue_oldest_wait_s": round(
+                self.scheduler.oldest_age_s(), 3),
+            # runtime introspection: process-global jit traces of the
+            # serving functions (per fn), and the delta since
+            # mark_steady() — nonempty in steady state is a bug
+            "recompiles": recompiles.counts(),
+            "recompiles_since_mark": self.recompiles_since_mark(),
+            "memory": self._mem.summary(),
         }
+        if self.flight is not None:
+            out["flight"] = {
+                "recorded": len(self.flight),
+                "dropped": self.flight.dropped,
+                "overhead_frac": round(
+                    self._flight_ns
+                    / max(self._tick_ns + self._flight_ns, 1), 5),
+            }
         if self.paged:
             out.update({
                 "blocks_in_use": self.pool.in_use_count(),

@@ -102,6 +102,19 @@ class BlockPool:
         but still registered — not yet back on the free list)."""
         return self.num_blocks - self.RESERVED - len(self._free)
 
+    def stats(self) -> dict:
+        """Plain-data snapshot for flight-recorder ticks and debugging:
+        total/free/in-use split, with in-use decomposed into live
+        (referenced) vs cached (ref 0, awaiting reuse or eviction)."""
+        live = int(np.count_nonzero(self.ref > 0))
+        return {
+            "total": self.num_blocks - self.RESERVED,
+            "free": len(self._free),
+            "in_use": self.in_use_count(),
+            "live": live,
+            "cached": self.in_use_count() - live,
+        }
+
     # -- alloc / free -------------------------------------------------------
 
     def alloc(self, n: int) -> List[int]:

@@ -233,6 +233,17 @@ class FIFOScheduler:
         with self._lock:
             return len(self._q)
 
+    def oldest_age_s(self) -> float:
+        """Seconds the head (oldest queued) request has been waiting;
+        0.0 when the queue is empty. The admission-latency SLO signal:
+        queue *depth* looks fine while one stuck head request starves —
+        its age does not. The engine publishes this per tick as the
+        ``serving_queue_oldest_wait_s`` gauge and in flight snapshots."""
+        with self._lock:
+            if not self._q:
+                return 0.0
+            return max(time.monotonic() - self._q[0].submit_t, 0.0)
+
     def pop_admissible(
         self, free_slots: int,
         admissible: Optional[Callable[[Request], bool]] = None,

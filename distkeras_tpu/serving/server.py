@@ -15,6 +15,8 @@ Protocol (all frames are msgpack dicts):
     {"op": "stats"}
     {"op": "metrics"}                         # registry snapshot
     {"op": "trace_dump", "trace"?: tid, "limit"?: n}
+    {"op": "flight", "last"?: n}              # flight-recorder ticks
+    {"op": "alerts"}                          # SLO monitor state
 
   server → client
     {"ok": 1, "id": rid, "trace": tid}        # generate accepted
@@ -24,6 +26,8 @@ Protocol (all frames are msgpack dicts):
     {"ok": 1, "stats": {...}}                 # stats reply
     {"ok": 1, "metrics": {...}}               # MetricRegistry.collect()
     {"ok": 1, "spans": [...]}                 # Tracer.dump()
+    {"ok": 1, "flight": {"meta":..,"ticks":[..]}}   # FlightRecorder ring
+    {"ok": 1, "alerts": [...]}                # SloMonitor.alerts()
 
 The ``trace`` id in the generate ack is the request's telemetry trace id
 (allocated at admission): ``trace_dump`` filtered to it returns the full
@@ -55,12 +59,22 @@ MAX_SERVE_FRAME_BYTES = 1 << 24  # 16 MiB
 class LMServer:
     """Serve a :class:`ServingEngine` over TCP. ``start()`` spins the
     accept loop and the engine's own loop thread; ``stop()`` winds both
-    down. Binds loopback unless an explicit host is given."""
+    down. Binds loopback unless an explicit host is given.
+
+    ``slo`` attaches an :class:`~distkeras_tpu.telemetry.SloMonitor`
+    (started/stopped with the server; served by the ``alerts`` op), and
+    ``watchdog_timeout_s`` arms the engine's stall watchdog — if the
+    loop thread stops ticking while work is pending, a flight
+    postmortem is dumped."""
 
     def __init__(self, engine: ServingEngine, host: str = "127.0.0.1",
                  port: int = 0,
-                 max_frame_bytes: int = MAX_SERVE_FRAME_BYTES):
+                 max_frame_bytes: int = MAX_SERVE_FRAME_BYTES,
+                 slo=None, watchdog_timeout_s: Optional[float] = None):
         self.engine = engine
+        self.slo = slo
+        self._watchdog = (engine.watchdog(timeout_s=watchdog_timeout_s)
+                          if watchdog_timeout_s is not None else None)
         self.max_frame_bytes = max_frame_bytes
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -75,10 +89,18 @@ class LMServer:
             t = threading.Thread(target=target, daemon=True)
             t.start()
             self._threads.append(t)
+        if self.slo is not None:
+            self.slo.start()
+        if self._watchdog is not None:
+            self._watchdog.start()
         return self
 
     def stop(self, timeout: float = 10.0):
         self._stop.set()
+        if self._watchdog is not None:
+            self._watchdog.stop()
+        if self.slo is not None:
+            self.slo.stop()
         try:
             self._sock.close()
         except OSError:
@@ -195,6 +217,27 @@ class LMServer:
                                    else int(msg["limit"])),
                         )
                         self._send(conn, lock, {"ok": 1, "spans": spans})
+                    elif op == "flight":
+                        fl = self.engine.flight
+                        if fl is None:
+                            self._send(conn, lock, {
+                                "ok": 0,
+                                "error": "flight recorder disabled",
+                            })
+                        else:
+                            last = (None if msg.get("last") is None
+                                    else int(msg["last"]))
+                            self._send(conn, lock, {"ok": 1, "flight": {
+                                "meta": fl.meta("scrape"),
+                                "ticks": fl.snapshots(last=last),
+                            }})
+                    elif op == "alerts":
+                        # no monitor attached -> no rules -> no alerts:
+                        # an empty list, not an error (clients probe)
+                        alerts = (self.slo.alerts()
+                                  if self.slo is not None else [])
+                        self._send(conn, lock,
+                                   {"ok": 1, "alerts": alerts})
                     else:
                         self._send(conn, lock,
                                    {"ok": 0, "error": f"unknown op {op!r}"})
@@ -350,6 +393,21 @@ class ServingClient:
         if limit is not None:
             msg["limit"] = int(limit)
         return list(self._call(msg)["spans"])
+
+    def flight(self, last: Optional[int] = None) -> dict:
+        """The server engine's flight-recorder ring:
+        ``{"meta": {...}, "ticks": [...]}`` (most recent ``last`` ticks
+        when given). Raises RuntimeError when the recorder is
+        disabled."""
+        msg: dict = {"op": "flight"}
+        if last is not None:
+            msg["last"] = int(last)
+        return dict(self._call(msg)["flight"])
+
+    def alerts(self) -> List[dict]:
+        """SLO alert state per rule (firing first); empty when the
+        server has no monitor attached."""
+        return list(self._call({"op": "alerts"})["alerts"])
 
     def close(self):
         try:

@@ -1,6 +1,6 @@
-"""Unified telemetry: request tracing, metric registry, live exposition.
+"""Unified telemetry: tracing, metrics, flight recording, SLO alerting.
 
-Three pieces, one import surface:
+Five pieces, one import surface:
 
 - :mod:`~distkeras_tpu.telemetry.trace` — per-request span tracing
   (``Tracer``): trace ids allocated at admission, spans recorded by every
@@ -10,11 +10,26 @@ Three pieces, one import surface:
   counters/gauges/histograms (``MetricRegistry``) that the serving
   engine, scheduler, parameter-server service, and trainers publish
   into; one process-global default, isolated instances on demand.
+- :mod:`~distkeras_tpu.telemetry.flight` — the black box
+  (``FlightRecorder``): a bounded ring of per-tick engine snapshots,
+  dumpable on demand (``flight`` op, ``/flight``) or automatically on
+  crash/stall (postmortem JSONL rendered by ``report --flight``).
+- :mod:`~distkeras_tpu.telemetry.slo` — declarative multi-window
+  burn-rate alerting over the registry (``SloMonitor`` + ``SloRule``,
+  the ``alerts`` op and ``/alerts``) and the ``StallWatchdog`` that
+  fires a postmortem when the engine stops making progress.
+- :mod:`~distkeras_tpu.telemetry.runtime` — runtime introspection:
+  the process-global :data:`~distkeras_tpu.telemetry.runtime.recompiles`
+  counter (traced-function bodies note each jit trace), host RSS, and
+  device-memory watermarks (``MemoryWatermarks``).
 - :mod:`~distkeras_tpu.telemetry.exposition` — the scrape side:
   Prometheus text rendering and a stdlib-HTTP ``TelemetryServer``
-  (``/metrics``, ``/metrics.json``, ``/traces``, ``/healthz``).
+  (``/metrics``, ``/metrics.json``, ``/traces``, ``/flight``,
+  ``/alerts``, ``/healthz``).
 
-Offline analysis: ``python -m distkeras_tpu.telemetry.report trace.jsonl``.
+Offline analysis: ``python -m distkeras_tpu.telemetry.report trace.jsonl``
+for span timelines, ``... report --flight dump.jsonl`` for tick
+timelines.
 
 This package is stdlib-only (no jax import) so instrumentation can never
 perturb device code, and every subsystem can import it without cycles.
@@ -23,6 +38,10 @@ perturb device code, and every subsystem can import it without cycles.
 from distkeras_tpu.telemetry.exposition import (  # noqa: F401
     TelemetryServer,
     render_prometheus,
+)
+from distkeras_tpu.telemetry.flight import (  # noqa: F401
+    POSTMORTEM_PREFIX,
+    FlightRecorder,
 )
 from distkeras_tpu.telemetry.registry import (  # noqa: F401
     FRACTION_BUCKETS,
@@ -33,6 +52,18 @@ from distkeras_tpu.telemetry.registry import (  # noqa: F401
     Histogram,
     MetricRegistry,
     get_registry,
+)
+from distkeras_tpu.telemetry.runtime import (  # noqa: F401
+    MemoryWatermarks,
+    RecompileCounter,
+    host_rss_bytes,
+    recompiles,
+)
+from distkeras_tpu.telemetry.slo import (  # noqa: F401
+    SloMonitor,
+    SloRule,
+    StallWatchdog,
+    default_serving_rules,
 )
 from distkeras_tpu.telemetry.trace import (  # noqa: F401
     Tracer,
@@ -49,6 +80,16 @@ __all__ = [
     "get_tracer",
     "TelemetryServer",
     "render_prometheus",
+    "FlightRecorder",
+    "POSTMORTEM_PREFIX",
+    "SloMonitor",
+    "SloRule",
+    "StallWatchdog",
+    "default_serving_rules",
+    "RecompileCounter",
+    "MemoryWatermarks",
+    "recompiles",
+    "host_rss_bytes",
     "LATENCY_MS_BUCKETS",
     "STALENESS_BUCKETS",
     "FRACTION_BUCKETS",

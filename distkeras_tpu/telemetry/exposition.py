@@ -14,6 +14,11 @@ Routes:
     /metrics.json   the same snapshot as JSON
     /traces         recent spans as JSON; ?trace=<id> filters one
                     request, ?limit=<n> truncates
+    /flight         flight-recorder tick snapshots as JSON
+                    ({"meta": ..., "ticks": [...]}); ?last=<n> keeps
+                    the most recent n; 404 when no recorder is wired
+    /alerts         SLO monitor state as JSON (firing rules first);
+                    404 when no monitor is wired
     /healthz        200 "ok" (liveness probe)
 """
 
@@ -98,9 +103,14 @@ class TelemetryServer:
 
     def __init__(self, registry: Optional[MetricRegistry] = None,
                  tracer: Optional[Tracer] = None,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 flight=None, slo=None):
         self.registry = registry or get_registry()
         self.tracer = tracer or get_tracer()
+        # optional panes: a FlightRecorder for /flight, an SloMonitor
+        # for /alerts (404 when not wired — scrape configs can probe)
+        self.flight = flight
+        self.slo = slo
         outer = self
 
         class _Handler(BaseHTTPRequestHandler):
@@ -140,6 +150,30 @@ class TelemetryServer:
                                                          limit=limit)),
                             "application/json",
                         )
+                    elif url.path == "/flight":
+                        if outer.flight is None:
+                            self._reply(404, "no flight recorder",
+                                        "text/plain")
+                        else:
+                            last = (int(q["last"][0])
+                                    if "last" in q else None)
+                            self._reply(
+                                200,
+                                json.dumps({
+                                    "meta": outer.flight.meta("scrape"),
+                                    "ticks": outer.flight.snapshots(
+                                        last=last),
+                                }),
+                                "application/json",
+                            )
+                    elif url.path == "/alerts":
+                        if outer.slo is None:
+                            self._reply(404, "no slo monitor",
+                                        "text/plain")
+                        else:
+                            self._reply(200,
+                                        json.dumps(outer.slo.alerts()),
+                                        "application/json")
                     elif url.path == "/healthz":
                         self._reply(200, "ok", "text/plain")
                     else:

@@ -304,6 +304,12 @@ class MetricRegistry:
         with self._lock:
             return list(self._metrics.values())
 
+    def get(self, name: str) -> Optional[_Metric]:
+        """The registered metric under ``name``, or None — read-side
+        lookup for consumers (the SLO monitor) that must not create."""
+        with self._lock:
+            return self._metrics.get(name)
+
     def collect(self) -> Dict[str, dict]:
         """Plain-data snapshot of every registered metric — the payload
         of the msgpack ``stats`` ops and ``/metrics.json``."""

@@ -1,14 +1,26 @@
-"""Render a trace JSONL into per-request timelines + a summary table.
+"""Render telemetry JSONL files: span timelines or flight-recorder ticks.
 
     python -m distkeras_tpu.telemetry.report /tmp/trace.jsonl
     python -m distkeras_tpu.telemetry.report /tmp/trace.jsonl --trace 17
     python -m distkeras_tpu.telemetry.report /tmp/trace.jsonl --top 5
+    python -m distkeras_tpu.telemetry.report --flight /tmp/distkeras-postmortem-*.jsonl
 
-Input is what :class:`~distkeras_tpu.telemetry.trace.Tracer` mirrors to
-``path=`` (or a saved ``trace_dump`` / ``/traces`` response, one span
-per line). Output answers the question the JSONL alone doesn't: *where
-did request N spend its time* — an aligned per-span timeline bar per
-trace, plus per-span-name duration percentiles across all traces.
+Span mode input is what :class:`~distkeras_tpu.telemetry.trace.Tracer`
+mirrors to ``path=`` (or a saved ``trace_dump`` / ``/traces`` response,
+one span per line). Output answers the question the JSONL alone doesn't:
+*where did request N spend its time* — an aligned per-span timeline bar
+per trace, plus per-span-name duration percentiles across all traces.
+
+``--flight`` mode renders a
+:class:`~distkeras_tpu.telemetry.flight.FlightRecorder` dump (manual or
+postmortem): one row per engine tick — occupancy, queue depth, the
+token-budget split, per-phase latency (host-plan / device / stream), and
+per-slot state — plus a phase breakdown and the slowest ticks, which is
+the "why did tick 48211 take 300 ms?" view.
+
+A missing, unreadable, or corrupt input file exits with status 2 and a
+one-line error — no traceback; dumps come from crashing processes, and
+the tool reading them must not crash too.
 """
 
 from __future__ import annotations
@@ -22,13 +34,45 @@ from typing import Dict, List, Optional, TextIO
 _BAR_WIDTH = 40
 
 
+class ReportError(Exception):
+    """Unusable input file: the CLI prints the message and exits 2."""
+
+
+def _load_jsonl(path: str) -> List[dict]:
+    recs = []
+    try:
+        with open(path) as fh:
+            for lineno, line in enumerate(fh, 1):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError as e:
+                    raise ReportError(
+                        f"{path}:{lineno}: not valid JSONL ({e.msg})"
+                    ) from None
+                if not isinstance(rec, dict):
+                    raise ReportError(
+                        f"{path}:{lineno}: expected one JSON object per "
+                        f"line, got {type(rec).__name__}"
+                    )
+                recs.append(rec)
+    except OSError as e:
+        raise ReportError(f"cannot read {path}: {e.strerror or e}") from None
+    except UnicodeDecodeError:
+        raise ReportError(f"{path}: not a text file") from None
+    return recs
+
+
 def load_spans(path: str) -> List[dict]:
-    spans = []
-    with open(path) as fh:
-        for line in fh:
-            line = line.strip()
-            if line:
-                spans.append(json.loads(line))
+    spans = _load_jsonl(path)
+    for i, s in enumerate(spans, 1):
+        if not {"trace", "span", "t0", "ms"} <= set(s):
+            raise ReportError(
+                f"{path}:{i}: not a span record (missing trace/span/ms "
+                f"keys) — for flight-recorder dumps use --flight"
+            )
     return spans
 
 
@@ -119,19 +163,135 @@ def report(path: str, trace: Optional[int] = None, top: int = 10,
     render_summary(spans, out)
 
 
+# -- flight-recorder dumps ---------------------------------------------------
+
+
+def _slot_cell(s) -> str:
+    """One slot's state, compact: 'r17:D-3' = request 17 decoding with 3
+    tokens left, 'r18:P+128' = prefilling with 128 prompt tokens
+    pending, '-' = idle."""
+    if not s:
+        return "-"
+    state = s.get("state", "?")[:1].upper()
+    if state == "P":
+        return f"r{s.get('rid', '?')}:P+{s.get('pending', '?')}"
+    return f"r{s.get('rid', '?')}:{state}-{s.get('remaining', '?')}"
+
+
+def report_flight(path: str, last: Optional[int] = None,
+                  slow: int = 5, out: Optional[TextIO] = None):
+    """Render a flight dump: the tick timeline, the phase breakdown,
+    and the slowest ticks (the postmortem reading order: tail of the
+    timeline → which phase ate the time → which tick blew up)."""
+    out = out or sys.stdout
+    recs = _load_jsonl(path)
+    meta = next((r for r in recs if r.get("kind") == "flight_meta"), None)
+    ticks = [r for r in recs if r.get("kind") == "tick"]
+    if meta is None and not ticks:
+        raise ReportError(
+            f"{path}: no flight_meta or tick records — is this a trace "
+            f"JSONL? (run without --flight)"
+        )
+    if meta is not None:
+        extras = {k: v for k, v in meta.items()
+                  if k in ("error", "progress", "stuck_s")}
+        out.write(
+            f"flight dump: reason={meta.get('reason')} "
+            f"pid={meta.get('pid')} — {meta.get('recorded', len(ticks))} "
+            f"ticks retained, {meta.get('dropped', 0)} aged out"
+            + ("  " + " ".join(f"{k}={v}" for k, v in extras.items())
+               if extras else "")
+            + "\n"
+        )
+    if not ticks:
+        out.write("(ring was empty — the engine never completed a tick)\n")
+        return
+    shown = ticks if last is None else ticks[-last:]
+    base_t = shown[0].get("t", 0.0)
+    out.write(
+        f"  {'tick':>7} {'t+s':>8} {'occ':>5} {'q':>3} "
+        f"{'dec':>4} {'pre':>4} {'plan':>7} {'device':>8} "
+        f"{'stream':>7} {'ms':>8}  slots\n"
+    )
+    for r in shown:
+        slots = r.get("slots")
+        cells = (" ".join(_slot_cell(s) for s in slots)
+                 if slots is not None else "")
+        extra = ""
+        if "blocks" in r:
+            b = r["blocks"]
+            extra = f"  blocks={b.get('in_use')}/{b.get('free')}free"
+        out.write(
+            f"  {r.get('tick', '?'):>7} "
+            f"{r.get('t', 0.0) - base_t:>8.3f} "
+            f"{r.get('occupancy', '?'):>5} "
+            f"{r.get('queue_depth', '?'):>3} "
+            f"{r.get('decode_tokens', '?'):>4} "
+            f"{r.get('prefill_tokens', '?'):>4} "
+            f"{r.get('plan_ms', 0.0):>7.2f} "
+            f"{r.get('device_ms', 0.0):>8.2f} "
+            f"{r.get('stream_ms', 0.0):>7.2f} "
+            f"{r.get('tick_ms', 0.0):>8.2f}  {cells}{extra}\n"
+        )
+    # phase breakdown + latency percentiles across ALL retained ticks
+    sums = {"plan": 0.0, "device": 0.0, "stream": 0.0}
+    tick_ms = []
+    for r in ticks:
+        tick_ms.append(float(r.get("tick_ms", 0.0)))
+        for k in sums:
+            sums[k] += float(r.get(f"{k}_ms", 0.0))
+    total = sum(sums.values()) or 1e-9
+    out.write(
+        f"\n{len(ticks)} ticks; phase share: "
+        + " ".join(f"{k} {100 * v / total:.1f}%"
+                   for k, v in sums.items())
+        + f"\ntick_ms: p50 {_percentile(tick_ms, 50):.2f}  "
+        f"p90 {_percentile(tick_ms, 90):.2f}  "
+        f"p99 {_percentile(tick_ms, 99):.2f}  max {max(tick_ms):.2f}\n"
+    )
+    worst = sorted(ticks, key=lambda r: float(r.get("tick_ms", 0.0)),
+                   reverse=True)[:slow]
+    out.write("slowest ticks: " + ", ".join(
+        f"{r.get('tick', '?')} ({float(r.get('tick_ms', 0.0)):.1f} ms)"
+        for r in worst
+    ) + "\n")
+    final = ticks[-1]
+    mem = next((r["mem"] for r in reversed(ticks) if r.get("mem")), None)
+    if mem:
+        out.write("memory at last sample: " + " ".join(
+            f"{k}={v}" for k, v in mem.items() if v is not None) + "\n")
+    if final.get("recompiles") is not None:
+        out.write(f"jit traces (process total): "
+                  f"{final['recompiles']}\n")
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description="Render a telemetry trace JSONL into per-request "
-                    "timelines and a span summary table."
+                    "timelines and a span summary table, or a "
+                    "flight-recorder dump into a tick timeline."
     )
-    ap.add_argument("path", help="trace JSONL (Tracer path= mirror)")
+    ap.add_argument("path", help="trace JSONL (Tracer path= mirror) or, "
+                                 "with --flight, a FlightRecorder dump")
     ap.add_argument("--trace", type=int, default=None,
                     help="render only this trace id")
     ap.add_argument("--top", type=int, default=10,
                     help="how many longest traces to render (default 10)")
+    ap.add_argument("--flight", action="store_true",
+                    help="input is a flight-recorder dump (postmortem "
+                         "or manual): render the tick timeline")
+    ap.add_argument("--last", type=int, default=None,
+                    help="flight mode: show only the most recent N ticks "
+                         "(summary still covers the whole dump)")
     args = ap.parse_args(argv)
     try:
-        report(args.path, trace=args.trace, top=args.top)
+        if args.flight:
+            report_flight(args.path, last=args.last)
+        else:
+            report(args.path, trace=args.trace, top=args.top)
+    except ReportError as e:
+        print(f"error: {e}", file=sys.stderr)
+        sys.exit(2)
     except BrokenPipeError:  # `... | head` closed the pipe: not an error
         import os
 

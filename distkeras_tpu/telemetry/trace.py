@@ -33,6 +33,7 @@ import itertools
 import json
 import threading
 import time
+import warnings
 from collections import deque
 from typing import List, Optional
 
@@ -77,7 +78,18 @@ class Tracer:
         with self._lock:
             self._buf.append(rec)
             if self._fh is not None:
-                self._fh.write(json.dumps(rec) + "\n")
+                try:
+                    self._fh.write(json.dumps(rec) + "\n")
+                except (OSError, ValueError) as e:
+                    # closed or unwritable mirror (disk full, fd closed
+                    # by a crashing test, ...): tracing must never take
+                    # a request down — drop the mirror, keep the ring
+                    self._fh = None
+                    warnings.warn(
+                        f"Tracer: JSONL mirror {self.path!r} failed "
+                        f"({e}); mirroring disabled, ring buffer "
+                        f"unaffected", RuntimeWarning, stacklevel=2,
+                    )
 
     @contextlib.contextmanager
     def span(self, trace: Optional[int], name: str, **attrs):
@@ -94,8 +106,15 @@ class Tracer:
     def dump(self, trace: Optional[int] = None,
              limit: Optional[int] = None) -> List[dict]:
         """Spans in arrival order, optionally filtered to one trace id
-        and/or truncated to the most recent ``limit``."""
+        and/or truncated to the most recent ``limit``. Flushes the
+        JSONL mirror first: a dump is a "look at the state now" moment,
+        and the on-disk view should match the ring the caller sees."""
         with self._lock:
+            if self._fh is not None:
+                try:
+                    self._fh.flush()
+                except (OSError, ValueError):
+                    self._fh = None
             spans = list(self._buf)
         if trace is not None:
             spans = [s for s in spans if s["trace"] == int(trace)]
@@ -112,8 +131,11 @@ class Tracer:
         buffer stays queryable."""
         with self._lock:
             if self._fh is not None:
-                self._fh.flush()
-                self._fh.close()
+                try:
+                    self._fh.flush()
+                    self._fh.close()
+                except (OSError, ValueError):
+                    pass
                 self._fh = None
 
     def __enter__(self) -> "Tracer":

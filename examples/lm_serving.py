@@ -8,9 +8,18 @@ the continuous-batching engine is the same math, just scheduled.
 
 Telemetry: the server's engine publishes into the process-global
 registry/tracer; ``--telemetry-port`` starts the HTTP scrape endpoint
-(``/metrics`` Prometheus text, ``/metrics.json``, ``/traces``), and the
-example always prints the first request's span chain (queued → prefill →
-decode → stream → finish) fetched over the TCP ``trace_dump`` op.
+(``/metrics`` Prometheus text, ``/metrics.json``, ``/traces``,
+``/flight``, ``/alerts``), and the example always prints the first
+request's span chain (queued → prefill → decode → stream → finish)
+fetched over the TCP ``trace_dump`` op.
+
+Flight recorder + SLO watchdog: the engine records one snapshot per tick
+(budget split, phase-decomposed latency, slot states); the example
+prints the last ticks fetched over the TCP ``flight`` op, attaches an
+:class:`SloMonitor` with the default serving rules (queried over the
+``alerts`` op), and arms the stall watchdog. ``--flight-dump PATH``
+writes the ring as JSONL — render it with
+``python -m distkeras_tpu.telemetry.report --flight PATH``.
 
 ``--paged`` serves through the block-paged KV cache with radix prefix
 sharing instead of the contiguous slot slabs: prompts open with a shared
@@ -25,6 +34,7 @@ prompt never stalls the tokens already streaming.
 
 Run: python examples/lm_serving.py [--prompts 4] [--max-new 16] [--slots 2]
      [--telemetry-port 9100] [--paged] [--prefill-chunk 16]
+     [--flight-dump /tmp/flight.jsonl]
 """
 
 import argparse
@@ -62,6 +72,10 @@ def main():
                          "into their slot this many tokens per decode "
                          "tick (0 = legacy monolithic prefill; default "
                          "64)")
+    ap.add_argument("--flight-dump", default=None, metavar="PATH",
+                    help="write the flight-recorder ring to this JSONL "
+                         "when done (render: python -m "
+                         "distkeras_tpu.telemetry.report --flight PATH)")
     args = ap.parse_args()
 
     model = get_model(
@@ -105,17 +119,24 @@ def main():
         bs = next(b for b in (8, 4, 2, 1) if max_len % b == 0)
         engine_kw.update(paged=True, block_size=bs)
     engine = ServingEngine(model, params, slots=args.slots, **engine_kw)
-    server = LMServer(engine).start()
+    # SLO monitor (default serving rules) + stall watchdog: the server
+    # starts/stops both; alerts are served over the TCP "alerts" op
+    from distkeras_tpu.telemetry import SloMonitor, default_serving_rules
+
+    slo = SloMonitor(default_serving_rules(), registry=engine.registry,
+                     tracer=engine.tracer, interval_s=0.25)
+    server = LMServer(engine, slo=slo, watchdog_timeout_s=30.0).start()
     telemetry_server = None
     if args.telemetry_port is not None:
         from distkeras_tpu.telemetry import TelemetryServer
 
         telemetry_server = TelemetryServer(
             registry=engine.registry, tracer=engine.tracer,
+            flight=engine.flight, slo=slo,
             port=args.telemetry_port,
         ).start()
         print(f"telemetry: http://127.0.0.1:{telemetry_server.port}"
-              f"/metrics (+ /metrics.json, /traces)")
+              f"/metrics (+ /metrics.json, /traces, /flight, /alerts)")
     client = ServingClient("127.0.0.1", server.port)
     try:
         rids = [client.generate(p, max_new_tokens=args.max_new)
@@ -154,6 +175,25 @@ def main():
                      if k not in ("trace", "span", "t0", "ms")}
             print(f"  trace {s['trace']} {s['span']:<8} {s['ms']:8.2f}ms "
                   + " ".join(f"{k}={v}" for k, v in attrs.items()))
+        # why was tick N slow? — the flight recorder's last ticks,
+        # phase-decomposed (host plan / device dispatch / stream fanout)
+        fl = client.flight(last=3)
+        print(f"flight recorder: {fl['meta']['recorded']} ticks retained; "
+              f"last {len(fl['ticks'])}:")
+        for t in fl["ticks"]:
+            print(f"  tick {t['tick']}: {t['tick_ms']:.2f}ms "
+                  f"(plan {t['plan_ms']:.2f} / device {t['device_ms']:.2f}"
+                  f" / stream {t['stream_ms']:.2f}), "
+                  f"occ {t['occupancy']}, emitted {t['emitted']}")
+        alerts = client.alerts()
+        firing = [a["rule"] for a in alerts if a["firing"]]
+        print(f"slo: {len(alerts)} rules, "
+              + (f"FIRING: {firing}" if firing else "none firing"))
+        if args.flight_dump:
+            n = engine.flight.dump(args.flight_dump, reason="example")
+            print(f"flight dump: {n} ticks -> {args.flight_dump} "
+                  f"(render: python -m distkeras_tpu.telemetry.report "
+                  f"--flight {args.flight_dump})")
     finally:
         client.close()
         server.stop()

@@ -172,6 +172,9 @@ def test_lm_serving_example_smoke(monkeypatch, capsys):
     out = capsys.readouterr().out
     assert out.count("parity OK") == 3
     assert "served 3 requests" in out
+    # PR 5: the example surfaces the flight recorder and SLO state
+    assert "flight recorder:" in out and "ticks retained" in out
+    assert "slo: 4 rules" in out
 
 
 def test_lm_serving_example_paged_smoke(monkeypatch, capsys):

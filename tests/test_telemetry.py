@@ -391,3 +391,139 @@ def test_report_cli_empty_file(tmp_path, capsys):
     path.write_text("")
     telemetry_report.main([str(path)])
     assert "no spans" in capsys.readouterr().out
+
+
+def test_report_cli_missing_file_exits_cleanly(tmp_path, capsys):
+    """Satellite (PR 5): a missing spans file is a one-line error with
+    exit status 2 — never a traceback (the tool reads dumps from
+    crashed processes; it must not crash too)."""
+    with pytest.raises(SystemExit) as ei:
+        telemetry_report.main([str(tmp_path / "nope.jsonl")])
+    assert ei.value.code == 2
+    err = capsys.readouterr().err
+    assert err.startswith("error:") and "nope.jsonl" in err
+    assert "Traceback" not in err
+
+
+def test_report_cli_corrupt_file_exits_cleanly(tmp_path, capsys):
+    # truncated/garbage JSON names the offending line
+    path = tmp_path / "bad.jsonl"
+    path.write_text('{"trace": 1, "span": "q", "t0": 0, "ms": 1}\n{oops\n')
+    with pytest.raises(SystemExit) as ei:
+        telemetry_report.main([str(path)])
+    assert ei.value.code == 2
+    assert ":2:" in capsys.readouterr().err
+    # valid JSON that is not span records (e.g. a flight dump fed
+    # without --flight) is also a clean error, pointing at --flight
+    fdump = tmp_path / "flight.jsonl"
+    fdump.write_text('{"kind": "flight_meta", "reason": "crash"}\n')
+    with pytest.raises(SystemExit) as ei:
+        telemetry_report.main([str(fdump)])
+    assert ei.value.code == 2
+    assert "--flight" in capsys.readouterr().err
+    # binary garbage: "not a text file", not UnicodeDecodeError
+    blob = tmp_path / "blob.jsonl"
+    blob.write_bytes(bytes(range(256)) * 4)
+    with pytest.raises(SystemExit) as ei:
+        telemetry_report.main([str(blob)])
+    assert ei.value.code == 2
+    assert "Traceback" not in capsys.readouterr().err
+
+
+# -- tracer JSONL mirror hardening (PR 5 satellites) ------------------------
+
+
+def test_tracer_dump_flushes_mirror(tmp_path):
+    """dump() is a look-at-state-now moment: the on-disk mirror must
+    already contain every span the returned list does."""
+    path = tmp_path / "trace.jsonl"
+    tr = telemetry.Tracer(path=str(path))
+    tr.record(1, "queued", 0.0, 1.0)
+    tr.record(1, "decode", 0.1, 2.0)
+    spans = tr.dump()
+    on_disk = [json.loads(x) for x in path.read_text().splitlines()]
+    assert on_disk == spans
+    tr.close()
+
+
+def test_tracer_survives_closed_mirror(tmp_path, recwarn):
+    """A closed/unwritable mirror must not raise mid-request: the write
+    path warns once, drops the mirror, and the ring keeps recording."""
+    path = tmp_path / "trace.jsonl"
+    tr = telemetry.Tracer(path=str(path))
+    tr.record(1, "before", 0.0, 1.0)
+    tr._fh.close()  # simulate an fd yanked out from under the tracer
+    tr._fh = open(path)  # reopen read-only: writes now raise
+    tr.record(1, "after", 0.1, 1.0)  # must not raise
+    assert any("mirroring disabled" in str(w.message)
+               for w in recwarn.list)
+    tr.record(1, "later", 0.2, 1.0)  # mirror dropped: silent, no raise
+    assert [s["span"] for s in tr.dump()] == ["before", "after", "later"]
+    tr.close()  # idempotent even after the mirror failed
+
+
+# -- Prometheus exposition edge cases (PR 5 satellite) ----------------------
+
+
+def test_prometheus_label_escaping():
+    reg = telemetry.MetricRegistry()
+    c = reg.counter("errs_total", "errors", labelnames=("msg",))
+    c.labels(msg='path "C:\\tmp"\nline2').inc()
+    text = telemetry.render_prometheus(reg)
+    # backslash, quote, and newline all escaped per the text format
+    assert r'msg="path \"C:\\tmp\"\nline2"' in text
+    assert "\nline2" not in text.split('msg="')[1].split("} ")[0]
+
+
+def test_prometheus_empty_histogram_and_empty_registry():
+    reg = telemetry.MetricRegistry()
+    reg.histogram("h_ms", "never observed", buckets=(1.0,))
+    reg.counter("c_total", "never incremented")
+    text = telemetry.render_prometheus(reg)
+    # declared-but-unobserved metrics render their TYPE header and no
+    # series — a scraper sees a well-formed, truthfully empty family
+    assert "# TYPE h_ms histogram" in text
+    assert "# TYPE c_total counter" in text
+    assert "h_ms_bucket" not in text and "c_total{" not in text
+    assert telemetry.render_prometheus(telemetry.MetricRegistry()) == "\n"
+
+
+def test_prometheus_scrape_concurrent_with_writes():
+    """A scrape taken mid-write must always parse: histogram bucket
+    lines monotone, counts consistent, no exceptions from either side."""
+    reg = telemetry.MetricRegistry()
+    h = reg.histogram("lat_ms", "l", buckets=(1.0, 10.0, 100.0))
+    c = reg.counter("ops_total", "o", labelnames=("op",))
+    stop = threading.Event()
+    errors = []
+
+    def writer(i):
+        try:
+            while not stop.is_set():
+                h.observe(float(i))
+                c.labels(op=f"w{i}").inc()
+        except Exception as e:  # pragma: no cover - the failure signal
+            errors.append(e)
+
+    threads = [threading.Thread(target=writer, args=(i,))
+               for i in (0, 5, 50)]
+    for t in threads:
+        t.start()
+    try:
+        for _ in range(50):
+            text = telemetry.render_prometheus(reg)
+            for line in text.splitlines():
+                if line.startswith("#") or not line:
+                    continue
+                # every sample line ends in a parseable number
+                float(line.rsplit(" ", 1)[1])
+            # cumulative bucket counts never decrease within a scrape
+            buckets = [int(ln.rsplit(" ", 1)[1])
+                       for ln in text.splitlines()
+                       if ln.startswith("lat_ms_bucket")]
+            assert buckets == sorted(buckets)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+    assert not errors
