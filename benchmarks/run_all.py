@@ -339,8 +339,35 @@ def config9():
     }))
 
 
+def config10():
+    """Tensor-parallel serving: the paged chunked engine under
+    shard_map on a 1-D model mesh at tp in {1, 2} vs the single-chip
+    engine (benchmarks/serve_bench.py --multichip). Decode tok/s per
+    device count lands in the MULTICHIP json trajectory; the smoke
+    asserts bit-identical token streams at every tp and zero
+    steady-state recompiles. On CPU runners the bench forces virtual
+    host devices, so the numbers measure dispatch (parity is the
+    point); TPU slices give the real scaling line."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import serve_bench
+
+    out = serve_bench.run_multichip(tp_list=(1, 2), smoke=True)
+    print(json.dumps({
+        "config": 10, "metric": "serving_tensor_parallel_decode_tok_s",
+        "value": out["multichip_decode_tok_s"],
+        "unit": "tokens/sec by tp degree",
+        "baseline_single_chip": out["baseline_decode_tok_s"],
+        "parity": out["parity"],
+        "steady_recompiles": out["steady_recompiles"],
+        "n_devices": out["n_devices"],
+        "backend": out["backend"],
+        "model": out["config"],
+        "data": "synthetic-closed-batch-trace",
+    }))
+
+
 CONFIGS = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5,
-           6: config6, 7: config7, 8: config8, 9: config9}
+           6: config6, 7: config7, 8: config8, 9: config9, 10: config10}
 
 
 def main():

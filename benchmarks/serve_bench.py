@@ -560,6 +560,139 @@ def bench_long_prompt_interference(
     return result
 
 
+def bench_multichip(tp_list=(1, 2), V=1024, D=256, H=8, Hk=4, L=4,
+                    slots=4, n_requests=16, prompt_len=16, max_new=32,
+                    block_size=16, dtype="float32", smoke=False):
+    """Tensor-parallel decode: the same paged chunked engine at
+    increasing mesh width (``make_mesh({'model': tp})``), measuring
+    sustained decode tokens/sec per tp against the single-chip
+    (mesh=None) engine. Token streams must be BIT-IDENTICAL to the
+    single-chip paged path at every tp, and the measured pass must hit
+    every jit cache (``recompiles_since_mark() == {}``).
+
+    On forced host devices (CPU CI) the numbers measure dispatch, not
+    silicon — the parity and recompile asserts are the point there;
+    real scaling numbers come from running this on a TPU slice, where
+    each shard's decode reads 1/tp of the KV cache per tick (the
+    bandwidth-bound decode lever). If the process has fewer devices
+    than ``max(tp_list)``, re-exec under
+    ``--xla_force_host_platform_device_count`` (the dryrun_multichip
+    pattern) before calling this."""
+    from distkeras_tpu import telemetry
+    from distkeras_tpu.models import get_model
+    from distkeras_tpu.parallel.mesh import make_mesh
+    from distkeras_tpu.serving import ServingEngine
+
+    if smoke:
+        V, D, H, Hk, L, slots = 64, 32, 8, 4, 2, 2
+        n_requests, prompt_len, max_new = 6, 8, 8
+        block_size = 8
+    need = max(tp_list)
+    if len(jax.devices()) < need:
+        raise RuntimeError(
+            f"bench_multichip needs {need} devices, have "
+            f"{len(jax.devices())} — run via --multichip (it forces "
+            f"host devices when short)"
+        )
+    max_len = prompt_len + max_new
+    max_len += (-max_len) % block_size
+    model = get_model(
+        "transformer_lm", vocab_size=V, d_model=D, num_heads=H,
+        num_layers=L, max_len=max_len, dtype=jnp.dtype(dtype),
+        attention="dense", num_kv_heads=Hk, pos_emb="rope",
+    )
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 4), jnp.int32))
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, V, size=prompt_len).astype(np.int32)
+               for _ in range(n_requests)]
+
+    def run(mesh):
+        eng = ServingEngine(
+            model, params, slots=slots, paged=True,
+            block_size=block_size, registry=telemetry.MetricRegistry(),
+            tracer=telemetry.Tracer(), mesh=mesh,
+        )
+
+        def one_pass():
+            reqs = [eng.submit(p, max_new_tokens=max_new)
+                    for p in prompts]
+            t0 = time.perf_counter()
+            eng.drain()
+            dt = time.perf_counter() - t0
+            streams = [r.stream.tokens(timeout=120) for r in reqs]
+            return streams, sum(map(len, streams)) / dt
+
+        one_pass()  # warm: trace every tick/prefill shape this run uses
+        eng.mark_steady()
+        streams, tps = one_pass()
+        return streams, tps, eng.recompiles_since_mark()
+
+    base_streams, base_tps, _ = run(None)
+    result = {
+        "baseline_decode_tok_s": round(base_tps, 1),
+        "multichip_decode_tok_s": {},
+        "parity": True,
+        "steady_recompiles": {},
+        "n_devices": len(jax.devices()),
+        "backend": jax.default_backend(),
+        "config": f"d{D}/h{H}kv{Hk}/L{L}/v{V}-slots{slots}"
+                  f"-req{n_requests}-prompt{prompt_len}+{max_new}"
+                  f"-bs{block_size}-{dtype}"
+                  + ("-smoke" if smoke else ""),
+    }
+    for tp in tp_list:
+        streams, tps, recomp = run(make_mesh({"model": tp}))
+        result["multichip_decode_tok_s"][f"tp{tp}"] = round(tps, 1)
+        result["parity"] = result["parity"] and (streams == base_streams)
+        result["steady_recompiles"].update(recomp)
+    if smoke:
+        # drift guards: sharding must not perturb a single token, and a
+        # steady-state measured pass must never re-trace
+        assert result["parity"], result
+        assert result["steady_recompiles"] == {}, result
+    print(json.dumps(result), flush=True)
+    return result
+
+
+def run_multichip(tp_list=(1, 2), smoke=False):
+    """bench_multichip with the dryrun_multichip respawn pattern: when
+    this process has fewer devices than max(tp_list) (one real chip, or
+    a plain CPU host), re-exec the bench in a subprocess with a forced
+    virtual CPU mesh — the env must be set before XLA initializes a
+    backend. Returns the bench's JSON dict either way."""
+    need = max(tp_list)
+    if len(jax.devices()) >= need:
+        return bench_multichip(tp_list=tp_list, smoke=smoke)
+
+    import subprocess
+
+    env = dict(os.environ)
+    flags = " ".join(
+        f for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    )
+    env["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={need}"
+    ).strip()
+    env["JAX_PLATFORMS"] = "cpu"
+    cmd = [sys.executable, os.path.abspath(__file__), "--multichip",
+           "--tp-list", ",".join(map(str, tp_list))]
+    if smoke:
+        cmd.append("--smoke")
+    proc = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                          timeout=1800)
+    sys.stderr.write(proc.stderr)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"multichip bench subprocess failed "
+            f"(rc={proc.returncode}):\n{proc.stderr[-2000:]}"
+        )
+    line = [ln for ln in proc.stdout.splitlines() if ln.startswith("{")][-1]
+    print(line, flush=True)
+    return json.loads(line)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--slots", type=int, default=8)
@@ -596,7 +729,23 @@ def main():
                     help="interference bench: pause (s) before each "
                          "closed-loop short refill — 0 saturates, > 0 "
                          "models paced traffic with idle headroom")
+    ap.add_argument("--multichip", action="store_true",
+                    help="tensor-parallel decode bench: the paged "
+                         "engine under shard_map at each tp in "
+                         "--tp-list vs single-chip, bit-identical "
+                         "streams asserted; forces virtual host "
+                         "devices when the process is short")
+    ap.add_argument("--tp-list", default="1,2",
+                    help="comma-separated tensor-parallel degrees for "
+                         "--multichip (default 1,2)")
     args = ap.parse_args()
+    if args.multichip:
+        tp_list = tuple(int(t) for t in args.tp_list.split(","))
+        if len(jax.devices()) >= max(tp_list):
+            bench_multichip(tp_list=tp_list, smoke=args.smoke)
+        else:
+            run_multichip(tp_list=tp_list, smoke=args.smoke)
+        return
     if args.long_prompt_interference:
         kw = dict(slots=args.slots, dtype=args.dtype, smoke=args.smoke,
                   tick_token_budget=args.tick_token_budget,

@@ -239,8 +239,32 @@ class CausalSelfAttention(nn.Module):
     paged: bool = False
     page_block_size: int = 16
     num_pages: int = 0
+    # paged attend implementation: 'auto' (the Pallas paged-attention
+    # kernel where ops.paged_attention.preferred says the shape tiles on
+    # this backend, else the gathered reference), 'pallas' (force the
+    # kernel — interpret mode off-TPU, the parity tests' lever), or
+    # 'gather' (force the XLA gather+einsum reference). The kernel DMAs
+    # pool pages straight off the block table and dequantizes int8 KV in
+    # VMEM; the gathered path materializes the whole [B, L, Hk, hd]
+    # (dequantized!) view per call and stays the bit-parity reference.
+    paged_kernel: str = "auto"
 
     _DENSE_MAX_T = 512  # short sequences: one fused dense block is fastest
+
+    def _use_paged_kernel(self, T, G, hd, quant) -> bool:
+        """Resolve ``paged_kernel`` for this call shape: 'auto' defers
+        to the kernel's own preferred() gate (TPU + tileable), 'pallas'
+        forces it (interpret mode off-TPU), 'gather' keeps the XLA
+        reference."""
+        if self.paged_kernel == "gather":
+            return False
+        if self.paged_kernel == "pallas":
+            return True
+        from distkeras_tpu.ops import paged_attention as _pa
+
+        store = 1 if quant else jnp.dtype(self.dtype).itemsize
+        return _pa.preferred(T, G, hd, self.page_block_size,
+                             store_itemsize=store)
 
     def _paged_attend(self, q, k, v, block_tables, seq_lens,
                       valid_lens=None):
@@ -314,13 +338,26 @@ class CausalSelfAttention(nn.Module):
             cv.value = put(cv.value, vq)
             ks.value = put(ks.value, k_s)
             vs.value = put(vs.value, v_s)
+        else:
+            ck.value = put(ck.value, k)
+            cv.value = put(cv.value, v)
+        if self._use_paged_kernel(T, H // Hk, hd, quant):
+            # Pallas paged attention: pages DMA'd straight off the block
+            # table, int8 dequant fused in VMEM — the gathered [B, L]
+            # view below never materializes (ops/paged_attention.py)
+            from distkeras_tpu.ops.paged_attention import paged_attention
+
+            return paged_attention(
+                q, ck.value, cv.value, block_tables, seq_lens,
+                ks.value if quant else None,
+                vs.value if quant else None,
+            )
+        if quant:
             keys = (view(ck.value).astype(jnp.float32)
                     * view(ks.value)[..., None]).astype(self.dtype)
             vals = (view(cv.value).astype(jnp.float32)
                     * view(vs.value)[..., None]).astype(self.dtype)
         else:
-            ck.value = put(ck.value, k)
-            cv.value = put(cv.value, v)
             keys, vals = view(ck.value), view(cv.value)
         scale = 1.0 / np.sqrt(hd)
         qg = q.reshape(B, T, Hk, G, hd)
@@ -492,6 +529,11 @@ class CausalSelfAttention(nn.Module):
                     "paged and slot_cursor are mutually exclusive cache "
                     "layouts"
                 )
+            if self.paged_kernel not in ("auto", "pallas", "gather"):
+                raise ValueError(
+                    f"Unknown paged_kernel '{self.paged_kernel}'. "
+                    "Known: auto, pallas, gather"
+                )
             if self.num_pages < 2:
                 raise ValueError(
                     f"paged mode needs num_pages >= 2 (block 0 is the "
@@ -650,6 +692,7 @@ class Block(nn.Module):
     paged: bool = False  # block-pooled KV cache (serving/kvpool.py)
     page_block_size: int = 16
     num_pages: int = 0
+    paged_kernel: str = "auto"  # paged attend: auto | pallas | gather
 
     @nn.compact
     def __call__(self, x, block_tables=None, seq_lens=None,
@@ -666,6 +709,7 @@ class Block(nn.Module):
             paged=self.paged,
             page_block_size=self.page_block_size,
             num_pages=self.num_pages,
+            paged_kernel=self.paged_kernel,
         )(h, block_tables, seq_lens, valid_lens)
         h = nn.LayerNorm(dtype=self.dtype)(x)
         if self.moe_experts > 0:
@@ -761,6 +805,11 @@ class TransformerLM(nn.Module):
     paged: bool = False
     page_block_size: int = 16
     num_pages: int = 0
+    # paged attend implementation: 'auto' (Pallas paged-attention kernel
+    # where the shape tiles on this backend — pages DMA'd off the block
+    # table, int8 dequant fused in VMEM), 'pallas' (force; interpret
+    # mode off-TPU), 'gather' (the XLA gather+einsum reference)
+    paged_kernel: str = "auto"
     # features_only=True returns the backbone's ln_f output [B, T, D]
     # instead of logits, for the fused chunked cross-entropy
     # (ops/fused_ce.py): the head matmul then happens INSIDE the loss,
@@ -872,6 +921,7 @@ class TransformerLM(nn.Module):
                 paged=self.paged,
                 page_block_size=self.page_block_size,
                 num_pages=self.num_pages,
+                paged_kernel=self.paged_kernel,
                 name=f"Block_{i}",
             )(x, block_tables, seq_lens, valid_lens)
         x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)

@@ -1,0 +1,247 @@
+"""Pallas TPU paged attention for the serving engine's block-pooled KV
+cache.
+
+The serving engine's gathered attend (``CausalSelfAttention._paged_attend``)
+materializes each row's cache view with an XLA gather — ``cache[block_tables]``
+— before a dense masked attend. That costs one full ``[B, L, Hk, hd]``
+HBM round trip per tick per layer, and under int8 KV it *dequantizes the
+whole gathered view* into model dtype first, doubling the stream it was
+supposed to halve. This kernel consumes the pool directly:
+
+- **Block tables drive the DMA.** The grid is ``(B, Hk, max_blocks)`` and
+  the K/V ``in_specs`` index maps look the physical page up in the
+  scalar-prefetched block table (``tables[b, j]``), so each program DMAs
+  exactly one ``[block_size, hd]`` page of one KV head out of the pool —
+  no gathered intermediate exists in HBM or VMEM.
+- **int8 dequant folded in.** Under ``cache_dtype='int8'`` the page
+  arrives as int8 plus its ``[block_size]`` f32 scales and is dequantized
+  in VMEM right before the matmul — the bf16/f32 K/V bytes never exist
+  outside the compute tile, so the HBM stream is the quantized one.
+- **GQA grouped natively.** Queries arrive per KV head as a
+  ``[T*G, hd]`` tile (``G`` = query heads per KV head), so the MXU matmul
+  covers the whole group without repeating K/V.
+- **Online softmax over pages** (same f32 running max/sum state as
+  :mod:`distkeras_tpu.ops.pallas_attention`), with the per-row absolute
+  positions from ``seq_lens`` masking exactly like the gathered attend:
+  row ``t`` of batch ``b`` sees positions ``<= seq_lens[b] + t``. Pages
+  wholly beyond a row's last query position are skipped with ``pl.when``
+  (their index map still clamps into the table, so the pipeline fetches
+  the trash page at worst).
+
+The kernel is the serving twin of the training-side kernels: forward
+only (decode never differentiates), per-page DMA (no ``[B, L]`` VMEM
+residency), interpret mode off-TPU so CPU test meshes run the identical
+program. Parity vs the gathered reference — MHA/GQA x int8 on/off x
+decode/chunk shapes — is asserted by tests/test_paged_kernel.py.
+
+Auto-select (:func:`preferred`) is deliberately narrow: real-TPU tiling
+wants lane-aligned ``hd`` (% 128), a sublane-aligned query tile
+(``T*G % 8``), and a sublane-aligned page size for the stored dtype —
+shapes outside that (e.g. single-token MHA decode, tiny test models)
+keep the gathered path, which remains the bit-parity reference.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _interpret() -> bool:
+    """Interpret mode off-TPU (CPU test meshes run the same program)."""
+    return jax.default_backend() != "tpu"
+
+
+def _struct(shape, dtype, like):
+    """Output aval carrying ``like``'s vma type when this jax tracks one
+    (see pallas_attention._out_struct): under ``shard_map`` on vma-aware
+    jax every pallas output must state how it varies — which is exactly
+    the sharded serving tick's case. Older jax (no ``jax.typeof``) takes
+    the plain struct."""
+    typeof = getattr(jax, "typeof", None)
+    vma = getattr(typeof(like), "vma", None) if typeof else None
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def supports(T: int, G: int, hd: int, block_size: int,
+             store_itemsize: int = 2) -> bool:
+    """Shapes the kernel serves on real TPU: lane-aligned head dim, a
+    sublane-aligned ``[T*G, hd]`` query tile, and pages whose token axis
+    is sublane-aligned for the stored KV dtype (int8 pages want 32-row
+    blocks). Everything else falls back to the gathered attend —
+    conservative, never a mis-tile. Interpret mode (tests) may run any
+    shape by forcing ``paged_kernel='pallas'``."""
+    sublane = 32 // store_itemsize
+    return hd % 128 == 0 and (T * G) % 8 == 0 and block_size % sublane == 0
+
+
+def preferred(T: int, G: int, hd: int, block_size: int,
+              store_itemsize: int = 2) -> bool:
+    """THE auto-select predicate (``paged_kernel='auto'``): TPU backend
+    and a supported shape. Mirrors pallas_attention.preferred so the
+    engine's recorded kernel label can't drift from what ran."""
+    if jax.default_backend() != "tpu":
+        return False
+    return supports(T, G, hd, block_size, store_itemsize)
+
+
+def _kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, *rest,
+            bs: int, T: int, G: int, nb: int, scale: float, quant: bool,
+            compute_dtype):
+    """One (batch row, KV head, page) program: DMA'd page -> dequant ->
+    grouped score tile -> online-softmax accumulate; finalize on the last
+    page. ``rest`` is (ks, vs, o, acc, m, l) when quant else (o, acc, m,
+    l)."""
+    if quant:
+        ks_ref, vs_ref, o_ref, acc, m_s, l_s = rest
+    else:
+        o_ref, acc, m_s, l_s = rest
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+    TG = T * G
+
+    @pl.when(j == 0)
+    def _():
+        acc[:] = jnp.zeros_like(acc)
+        m_s[:] = jnp.full_like(m_s, _NEG_INF)
+        l_s[:] = jnp.zeros_like(l_s)
+
+    start = lens_ref[b]
+
+    # pages wholly beyond this row's last query position do no work (the
+    # causal bound of an append-only cache); their table entry is 0, so
+    # the pipeline at worst re-fetches the trash page
+    @pl.when(j * bs <= start + T - 1)
+    def _():
+        q = q_ref[0, 0]  # [TG, hd]
+        kb = k_ref[0, :, 0, :]  # [bs, hd] — one page of one KV head
+        vb = v_ref[0, :, 0, :]
+        if quant:
+            # dequant IN VMEM: the bf16/f32 K/V bytes never exist
+            # outside this tile (the gathered path materialized the
+            # whole dequantized view in HBM first)
+            kb = (kb.astype(jnp.float32)
+                  * ks_ref[0, :, 0][:, None]).astype(compute_dtype)
+            vb = (vb.astype(jnp.float32)
+                  * vs_ref[0, :, 0][:, None]).astype(compute_dtype)
+        s = jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [TG, bs]
+        # query row r = t * G + g sits at absolute position start + t;
+        # key slot i of page j is absolute position j * bs + i
+        qpos = start + jax.lax.broadcasted_iota(
+            jnp.int32, (TG, 1), 0) // G
+        kpos = j * bs + jax.lax.broadcasted_iota(jnp.int32, (1, bs), 1)
+        s = jnp.where(kpos <= qpos, s, _NEG_INF)
+        m_old = m_s[:]
+        m_new = jnp.maximum(m_old, jnp.max(s, axis=-1, keepdims=True))
+        corr = jnp.exp(m_old - m_new)
+        p = jnp.exp(s - m_new)
+        l_s[:] = l_s[:] * corr + jnp.sum(p, axis=-1, keepdims=True)
+        m_s[:] = m_new
+        pv = jax.lax.dot_general(
+            p.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc[:] = acc[:] * corr + pv
+
+    @pl.when(j == nb - 1)
+    def _():
+        # position 0 is always visible to every real row, so l > 0;
+        # padding rows of a chunked tick normalize garbage nobody reads
+        o_ref[0, 0] = (acc[:] / jnp.maximum(l_s[:], 1e-30)).astype(
+            o_ref.dtype)
+
+
+def paged_attention(q, k_pages, v_pages, block_tables, seq_lens,
+                    key_scales=None, value_scales=None):
+    """Paged causal attention over a block-pooled KV cache.
+
+    Args:
+      q: ``[B, T, H, hd]`` queries (rope already applied, unscaled).
+      k_pages / v_pages: ``[num_pages, block_size, Hk, hd]`` pool, model
+        dtype or int8 (then pass the scales).
+      block_tables: ``[B, max_blocks]`` int32 physical page ids per row
+        (entries past a row's chain point at the reserved trash page 0).
+      seq_lens: ``[B]`` int32 — row ``b``'s query ``t`` sits at absolute
+        position ``seq_lens[b] + t`` and attends positions ``<= that``.
+      key_scales / value_scales: ``[num_pages, block_size, Hk]`` f32
+        dequant scales for int8 pools (both or neither).
+
+    Returns ``[B, T, H, hd]`` in ``q.dtype`` — same contract as the
+    gathered attend in ``CausalSelfAttention._paged_attend``, which stays
+    the bit-parity reference.
+    """
+    B, T, H, hd = q.shape
+    _, bs, Hk, _ = k_pages.shape
+    if H % Hk:
+        raise ValueError(f"H={H} not divisible by Hk={Hk}")
+    quant = key_scales is not None
+    if quant != (value_scales is not None):
+        raise ValueError("pass both key_scales and value_scales or neither")
+    G = H // Hk
+    NB = block_tables.shape[-1]
+    TG = T * G
+    # queries per KV head: row r = t * G + g — one clean [TG, hd] MXU
+    # tile covers the whole GQA group without repeating K/V
+    qr = q.reshape(B, T, Hk, G, hd).transpose(0, 2, 1, 3, 4).reshape(
+        B, Hk, TG, hd)
+
+    kern = functools.partial(
+        _kernel, bs=bs, T=T, G=G, nb=NB, scale=1.0 / np.sqrt(hd),
+        quant=quant, compute_dtype=q.dtype,
+    )
+
+    def page_idx(b, h, j, tables, lens):
+        # the paged-attention trick: the BlockSpec index map looks the
+        # physical page up in the scalar-prefetched table, so the
+        # pipeline DMAs pool pages directly — no gathered intermediate
+        return (tables[b * NB + j], 0, h, 0)
+
+    def scale_idx(b, h, j, tables, lens):
+        return (tables[b * NB + j], 0, h)
+
+    def q_idx(b, h, j, tables, lens):
+        return (b, h, 0, 0)
+
+    in_specs = [
+        pl.BlockSpec((1, 1, TG, hd), q_idx),
+        pl.BlockSpec((1, bs, 1, hd), page_idx),
+        pl.BlockSpec((1, bs, 1, hd), page_idx),
+    ]
+    args = [qr, k_pages, v_pages]
+    if quant:
+        in_specs += [
+            pl.BlockSpec((1, bs, 1), scale_idx),
+            pl.BlockSpec((1, bs, 1), scale_idx),
+        ]
+        args += [key_scales, value_scales]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, Hk, NB),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, 1, TG, hd), q_idx),
+        scratch_shapes=[
+            pltpu.VMEM((TG, hd), jnp.float32),
+            pltpu.VMEM((TG, 1), jnp.float32),
+            pltpu.VMEM((TG, 1), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kern,
+        grid_spec=grid_spec,
+        out_shape=_struct((B, Hk, TG, hd), q.dtype, q),
+        interpret=_interpret(),
+    )(block_tables.reshape(-1), seq_lens, *args)
+    return out.reshape(B, Hk, T, G, hd).transpose(0, 2, 1, 3, 4).reshape(
+        B, T, H, hd)

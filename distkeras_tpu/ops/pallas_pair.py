@@ -24,8 +24,12 @@ normalized output), this kernel:
   ``d lse_i / d s_ij = p_ij``, so the Dao backward's
   ``ds = p * (dp - delta)`` becomes ``ds = p * (dp - delta + dlse_i)``
   — one extra broadcast add, no extra matmuls;
-- supports ``causal`` for the step-0 diagonal chunks (local positions,
-  wedge-skipped like the big kernel).
+- supports ``causal`` for the step-0 diagonal chunks (local positions),
+  with the causal grid PRUNED to the lower-triangle wedge: scalar-
+  prefetched (i, j) index vectors flatten the KV walk to nq(nq+1)/2
+  steps, so upper-triangle iterations neither burn grid steps nor issue
+  clamped block DMAs (the rectangular grid skipped their compute but
+  still walked them).
 
 Layouts match .pallas_attention: heads folded into batch, per-block KV
 DMA, lse/delta as lane-replicated ``(block, LSE_LANES)`` f32 tiles.
@@ -37,6 +41,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -53,14 +58,76 @@ from distkeras_tpu.ops.pallas_attention import (
 _NEG_INF = -1e30
 
 
+# Causal grids are PRUNED to the lower-triangle wedge: the rectangular
+# (nq, nk) grid burned nk steps per query row even though j > i tiles do
+# no work — each skipped step still walks the grid and issues the
+# (clamped) diagonal-block DMA request. Instead the wedge's nq(nq+1)/2
+# (i, j) pairs are enumerated into scalar-prefetched index vectors and
+# the KV walk becomes ONE flattened grid dimension whose index maps read
+# them — ~2x fewer grid steps at any chunk count, zero skipped
+# iterations. Row-major (i ascending, j = 0..i) keeps the forward/dq
+# scratch discipline (init at j == 0, finalize at j == i); the dkv wedge
+# is column-major (j ascending, i = j..nq-1: init at i == j, finalize at
+# i == nq-1).
+
+
+@functools.lru_cache(maxsize=64)
+def _tri_rows(n: int):
+    """Row-major wedge enumeration: i[t], j[t] with j <= i."""
+    ii, jj = np.tril_indices(n)
+    return np.asarray(ii, np.int32), np.asarray(jj, np.int32)
+
+
+@functools.lru_cache(maxsize=64)
+def _tri_cols(n: int):
+    """Column-major wedge enumeration: j ascending, i = j..n-1."""
+    jj, ii = np.triu_indices(n)
+    return np.asarray(ii, np.int32), np.asarray(jj, np.int32)
+
+
 # ---------------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------------
 
 
+def _fwd_compute(i, j, q_ref, k_ref, v_ref, acc, m_s, l_s,
+                 *, block: int, causal: bool):
+    """One (q block i, kv block j) online-softmax accumulation step —
+    shared by the rectangular grid and the pruned causal wedge."""
+    s = jax.lax.dot_general(
+        q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    if causal:
+        q_pos = i * block + jax.lax.broadcasted_iota(
+            jnp.int32, (block, 1), 0)
+        k_pos = j * block + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block), 1)
+        s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+    m_old = m_s[:]
+    m_new = jnp.maximum(m_old, jnp.max(s, axis=-1, keepdims=True))
+    corr = jnp.exp(m_old - m_new)
+    p = jnp.exp(s - m_new)
+    l_s[:] = l_s[:] * corr + jnp.sum(p, axis=-1, keepdims=True)
+    m_s[:] = m_new
+    pv = jax.lax.dot_general(
+        p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    acc[:] = acc[:] * corr + pv
+
+
+def _fwd_finalize(o_ref, l_ref, acc, m_s, l_s, *, block: int):
+    l_safe = jnp.maximum(l_s[:], 1e-30)
+    o_ref[0] = (acc[:] / l_safe).astype(o_ref.dtype)
+    l_ref[0] = jnp.broadcast_to(
+        m_s[:] + jnp.log(l_safe), (block, LSE_LANES)
+    )
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, l_ref, acc, m_s, l_s,
-                *, block: int, causal: bool):
-    i = pl.program_id(1)
+                *, block: int):
+    """Rectangular (non-causal) forward: full nq x nk walk."""
     j = pl.program_id(2)
     nj = pl.num_programs(2)
 
@@ -70,61 +137,93 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, l_ref, acc, m_s, l_s,
         m_s[:] = jnp.full_like(m_s, _NEG_INF)
         l_s[:] = jnp.zeros_like(l_s)
 
-    @pl.when((not causal) or (j <= i))
-    def _():
-        s = jax.lax.dot_general(
-            q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        if causal:
-            q_pos = i * block + jax.lax.broadcasted_iota(
-                jnp.int32, (block, 1), 0)
-            k_pos = j * block + jax.lax.broadcasted_iota(
-                jnp.int32, (1, block), 1)
-            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
-        m_old = m_s[:]
-        m_new = jnp.maximum(m_old, jnp.max(s, axis=-1, keepdims=True))
-        corr = jnp.exp(m_old - m_new)
-        p = jnp.exp(s - m_new)
-        l_s[:] = l_s[:] * corr + jnp.sum(p, axis=-1, keepdims=True)
-        m_s[:] = m_new
-        pv = jax.lax.dot_general(
-            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        acc[:] = acc[:] * corr + pv
+    _fwd_compute(pl.program_id(1), j, q_ref, k_ref, v_ref, acc, m_s,
+                 l_s, block=block, causal=False)
 
-    last = i if causal else nj - 1
-
-    @pl.when(j == last)
+    @pl.when(j == nj - 1)
     def _():
-        l_safe = jnp.maximum(l_s[:], 1e-30)
-        o_ref[0] = (acc[:] / l_safe).astype(o_ref.dtype)
-        l_ref[0] = jnp.broadcast_to(
-            m_s[:] + jnp.log(l_safe), (block, LSE_LANES)
-        )
+        _fwd_finalize(o_ref, l_ref, acc, m_s, l_s, block=block)
+
+
+def _fwd_kernel_tri(im_ref, jm_ref, q_ref, k_ref, v_ref, o_ref, l_ref,
+                    acc, m_s, l_s, *, block: int):
+    """Pruned causal forward: the grid IS the wedge (scalar-prefetched
+    (i, j) pairs, row-major), so every step does work — no skipped
+    iterations, no upper-triangle DMAs."""
+    t = pl.program_id(1)
+    i = im_ref[t]
+    j = jm_ref[t]
+
+    @pl.when(j == 0)
+    def _():
+        acc[:] = jnp.zeros_like(acc)
+        m_s[:] = jnp.full_like(m_s, _NEG_INF)
+        l_s[:] = jnp.zeros_like(l_s)
+
+    _fwd_compute(i, j, q_ref, k_ref, v_ref, acc, m_s, l_s,
+                 block=block, causal=True)
+
+    @pl.when(j == i)
+    def _():
+        _fwd_finalize(o_ref, l_ref, acc, m_s, l_s, block=block)
 
 
 def _fwd(q3, k3, v3, block: int, causal: bool):
     BH, Tq, hd = q3.shape
     Tk = k3.shape[1]
     nq, nk = Tq // block, Tk // block
+    out_shape = [
+        _out_struct((BH, Tq, hd), q3.dtype, q3),
+        _out_struct((BH, Tq, LSE_LANES), jnp.float32, q3),
+    ]
+    scratch = [
+        pltpu.VMEM((block, hd), jnp.float32),
+        pltpu.VMEM((block, 1), jnp.float32),
+        pltpu.VMEM((block, 1), jnp.float32),
+    ]
 
     if causal:
-        def kv_idx(b, i, j):
-            return (b, jnp.minimum(i, j), 0)
-    else:
-        def kv_idx(b, i, j):
-            return (b, j, 0)
+        # diagonal pair chunks have Tq == Tk (the ring guarantees it)
+        im, jm = _tri_rows(nq)
+
+        def q_idx(b, t, im_, jm_):
+            return (b, im_[t], 0)
+
+        def kv_idx(b, t, im_, jm_):
+            return (b, jm_[t], 0)
+
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(BH, len(im)),
+            in_specs=[
+                pl.BlockSpec((1, block, hd), q_idx),
+                pl.BlockSpec((1, block, hd), kv_idx),
+                pl.BlockSpec((1, block, hd), kv_idx),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, block, hd), q_idx),
+                pl.BlockSpec((1, block, LSE_LANES), q_idx),
+            ],
+            scratch_shapes=scratch,
+        )
+        return pl.pallas_call(
+            functools.partial(_fwd_kernel_tri, block=block),
+            grid_spec=grid_spec,
+            out_shape=out_shape,
+            interpret=_interpret(),
+            **_call_kwargs(block),
+        )(jnp.asarray(im), jnp.asarray(jm), q3, k3, v3)
 
     return pl.pallas_call(
-        functools.partial(_fwd_kernel, block=block, causal=causal),
+        functools.partial(_fwd_kernel, block=block),
         grid=(BH, nq, nk),
         in_specs=[
             pl.BlockSpec((1, block, hd), lambda b, i, j: (b, i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block, hd), kv_idx, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block, hd), kv_idx, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block, hd), lambda b, i, j: (b, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block, hd), lambda b, i, j: (b, j, 0),
+                         memory_space=pltpu.VMEM),
         ],
         out_specs=[
             pl.BlockSpec((1, block, hd), lambda b, i, j: (b, i, 0),
@@ -132,15 +231,8 @@ def _fwd(q3, k3, v3, block: int, causal: bool):
             pl.BlockSpec((1, block, LSE_LANES), lambda b, i, j: (b, i, 0),
                          memory_space=pltpu.VMEM),
         ],
-        out_shape=[
-            _out_struct((BH, Tq, hd), q3.dtype, q3),
-            _out_struct((BH, Tq, LSE_LANES), jnp.float32, q3),
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((block, hd), jnp.float32),
-            pltpu.VMEM((block, 1), jnp.float32),
-            pltpu.VMEM((block, 1), jnp.float32),
-        ],
+        out_shape=out_shape,
+        scratch_shapes=scratch,
         interpret=_interpret(),
         **_call_kwargs(block),
     )(q3, k3, v3)
@@ -151,9 +243,44 @@ def _fwd(q3, k3, v3, block: int, causal: bool):
 # ---------------------------------------------------------------------------
 
 
+def _dq_compute(i, j, q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
+                dlse_ref, dq_acc, *, block: int, causal: bool):
+    q = q_ref[0]
+    kb = k_ref[0]
+    do = do_ref[0]
+    lse = lse_ref[0][:, :1]
+    dlse = dlse_ref[0][:, :1]
+    delta = jnp.sum(
+        do.astype(jnp.float32) * o_ref[0].astype(jnp.float32),
+        axis=-1, keepdims=True,
+    )
+    s = jax.lax.dot_general(
+        q, kb, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    if causal:
+        q_pos = i * block + jax.lax.broadcasted_iota(
+            jnp.int32, (block, 1), 0)
+        k_pos = j * block + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block), 1)
+        s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+    p = jnp.exp(s - lse)
+    dp = jax.lax.dot_general(
+        do, v_ref[0], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    # d lse_i / d s_ij = p_ij: the lse cotangent rides the same
+    # softmax-weighted path as -delta
+    ds = p * (dp - delta + dlse)
+    dq_acc[:] = dq_acc[:] + jax.lax.dot_general(
+        ds.astype(kb.dtype), kb, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dlse_ref,
-               dq_ref, dq_acc, *, block: int, causal: bool):
-    i = pl.program_id(1)
+               dq_ref, dq_acc, *, block: int):
+    """Rectangular (non-causal) dq: full nq x nk walk."""
     j = pl.program_id(2)
     nj = pl.num_programs(2)
 
@@ -161,51 +288,77 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dlse_ref,
     def _():
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
-    @pl.when((not causal) or (j <= i))
-    def _():
-        q = q_ref[0]
-        kb = k_ref[0]
-        do = do_ref[0]
-        lse = lse_ref[0][:, :1]
-        dlse = dlse_ref[0][:, :1]
-        delta = jnp.sum(
-            do.astype(jnp.float32) * o_ref[0].astype(jnp.float32),
-            axis=-1, keepdims=True,
-        )
-        s = jax.lax.dot_general(
-            q, kb, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        if causal:
-            q_pos = i * block + jax.lax.broadcasted_iota(
-                jnp.int32, (block, 1), 0)
-            k_pos = j * block + jax.lax.broadcasted_iota(
-                jnp.int32, (1, block), 1)
-            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
-        p = jnp.exp(s - lse)
-        dp = jax.lax.dot_general(
-            do, v_ref[0], (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        # d lse_i / d s_ij = p_ij: the lse cotangent rides the same
-        # softmax-weighted path as -delta
-        ds = p * (dp - delta + dlse)
-        dq_acc[:] = dq_acc[:] + jax.lax.dot_general(
-            ds.astype(kb.dtype), kb, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
+    _dq_compute(pl.program_id(1), j, q_ref, k_ref, v_ref, do_ref,
+                o_ref, lse_ref, dlse_ref, dq_acc, block=block,
+                causal=False)
 
-    last = i if causal else nj - 1
-
-    @pl.when(j == last)
+    @pl.when(j == nj - 1)
     def _():
         # q arrived pre-scaled, so this IS d/d(pre-scaled q)
         dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
 
 
+def _dq_kernel_tri(im_ref, jm_ref, q_ref, k_ref, v_ref, do_ref, o_ref,
+                   lse_ref, dlse_ref, dq_ref, dq_acc, *, block: int):
+    """Pruned causal dq: row-major wedge, every step does work."""
+    t = pl.program_id(1)
+    i = im_ref[t]
+    j = jm_ref[t]
+
+    @pl.when(j == 0)
+    def _():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    _dq_compute(i, j, q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
+                dlse_ref, dq_acc, block=block, causal=True)
+
+    @pl.when(j == i)
+    def _():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _dkv_compute(i, j, q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
+                 dlse_ref, dk_acc, dv_acc, *, block: int, causal: bool):
+    q = q_ref[0]
+    kb = k_ref[0]
+    vb = v_ref[0]
+    do = do_ref[0]
+    lse = lse_ref[0][:, :1]
+    dlse = dlse_ref[0][:, :1]
+    delta = jnp.sum(
+        do.astype(jnp.float32) * o_ref[0].astype(jnp.float32),
+        axis=-1, keepdims=True,
+    )
+    s = jax.lax.dot_general(
+        q, kb, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    if causal:
+        q_pos = i * block + jax.lax.broadcasted_iota(
+            jnp.int32, (block, 1), 0)
+        k_pos = j * block + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block), 1)
+        s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+    p = jnp.exp(s - lse)
+    pc = p.astype(do.dtype)
+    dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
+        pc, do, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    dp = jax.lax.dot_general(
+        do, vb, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    ds = (p * (dp - delta + dlse)).astype(q.dtype)
+    dk_acc[:] = dk_acc[:] + jax.lax.dot_general(
+        ds, q, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dlse_ref,
-                dk_ref, dv_ref, dk_acc, dv_acc, *, block: int,
-                causal: bool):
+                dk_ref, dv_ref, dk_acc, dv_acc, *, block: int):
+    """Rectangular (non-causal) dk/dv: full nk x nq walk."""
     j = pl.program_id(1)
     i = pl.program_id(2)
     ni = pl.num_programs(2)
@@ -215,43 +368,31 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dlse_ref,
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    @pl.when((not causal) or (i >= j))
+    _dkv_compute(i, j, q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
+                 dlse_ref, dk_acc, dv_acc, block=block, causal=False)
+
+    @pl.when(i == ni - 1)
     def _():
-        q = q_ref[0]
-        kb = k_ref[0]
-        vb = v_ref[0]
-        do = do_ref[0]
-        lse = lse_ref[0][:, :1]
-        dlse = dlse_ref[0][:, :1]
-        delta = jnp.sum(
-            do.astype(jnp.float32) * o_ref[0].astype(jnp.float32),
-            axis=-1, keepdims=True,
-        )
-        s = jax.lax.dot_general(
-            q, kb, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        if causal:
-            q_pos = i * block + jax.lax.broadcasted_iota(
-                jnp.int32, (block, 1), 0)
-            k_pos = j * block + jax.lax.broadcasted_iota(
-                jnp.int32, (1, block), 1)
-            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
-        p = jnp.exp(s - lse)
-        pc = p.astype(do.dtype)
-        dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
-            pc, do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        dp = jax.lax.dot_general(
-            do, vb, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        ds = (p * (dp - delta + dlse)).astype(q.dtype)
-        dk_acc[:] = dk_acc[:] + jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _dkv_kernel_tri(im_ref, jm_ref, q_ref, k_ref, v_ref, do_ref, o_ref,
+                    lse_ref, dlse_ref, dk_ref, dv_ref, dk_acc, dv_acc,
+                    *, block: int, ni: int):
+    """Pruned causal dk/dv: column-major wedge (j ascending, i from the
+    diagonal down) — init at i == j, finalize at the last query block."""
+    t = pl.program_id(1)
+    i = im_ref[t]
+    j = jm_ref[t]
+
+    @pl.when(i == j)
+    def _():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    _dkv_compute(i, j, q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
+                 dlse_ref, dk_acc, dv_acc, block=block, causal=True)
 
     @pl.when(i == ni - 1)
     def _():
@@ -265,19 +406,81 @@ def _bwd_impl(q3, k3, v3, out, lse, do3, dlse, block: int, causal: bool):
     nq, nk = Tq // block, Tk // block
 
     if causal:
-        def kv_row_idx(b, i, j):
-            return (b, jnp.minimum(i, j), 0)
+        # pruned wedge grids: dq walks (i, j <= i) row-major, dkv walks
+        # (j, i >= j) column-major — nq(nq+1)/2 steps each instead of
+        # nq * nk, and no skipped iterations issuing clamped DMAs
+        im_r, jm_r = _tri_rows(nq)
 
-        def q_col_idx(b, j, i):
-            return (b, jnp.maximum(i, j), 0)
-    else:
-        def kv_row_idx(b, i, j):
-            return (b, j, 0)
+        def q_tri(b, t, im_, jm_):
+            return (b, im_[t], 0)
 
-        def q_col_idx(b, j, i):
-            return (b, i, 0)
+        def kv_tri(b, t, im_, jm_):
+            return (b, jm_[t], 0)
+
+        dq = pl.pallas_call(
+            functools.partial(_dq_kernel_tri, block=block),
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=2,
+                grid=(BH, len(im_r)),
+                in_specs=[
+                    pl.BlockSpec((1, block, hd), q_tri),
+                    pl.BlockSpec((1, block, hd), kv_tri),
+                    pl.BlockSpec((1, block, hd), kv_tri),
+                    pl.BlockSpec((1, block, hd), q_tri),
+                    pl.BlockSpec((1, block, hd), q_tri),
+                    pl.BlockSpec((1, block, LSE_LANES), q_tri),
+                    pl.BlockSpec((1, block, LSE_LANES), q_tri),
+                ],
+                out_specs=pl.BlockSpec((1, block, hd), q_tri),
+                scratch_shapes=[pltpu.VMEM((block, hd), jnp.float32)],
+            ),
+            out_shape=_out_struct((BH, Tq, hd), q3.dtype, q3),
+            interpret=_interpret(),
+            **_call_kwargs(block),
+        )(jnp.asarray(im_r), jnp.asarray(jm_r),
+          q3, k3, v3, do3, out, lse, dlse)
+
+        im_c, jm_c = _tri_cols(nq)
+        dk, dv = pl.pallas_call(
+            functools.partial(_dkv_kernel_tri, block=block, ni=nq),
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=2,
+                grid=(BH, len(im_c)),
+                in_specs=[
+                    pl.BlockSpec((1, block, hd), q_tri),
+                    pl.BlockSpec((1, block, hd), kv_tri),
+                    pl.BlockSpec((1, block, hd), kv_tri),
+                    pl.BlockSpec((1, block, hd), q_tri),
+                    pl.BlockSpec((1, block, hd), q_tri),
+                    pl.BlockSpec((1, block, LSE_LANES), q_tri),
+                    pl.BlockSpec((1, block, LSE_LANES), q_tri),
+                ],
+                out_specs=[
+                    pl.BlockSpec((1, block, hd), kv_tri),
+                    pl.BlockSpec((1, block, hd), kv_tri),
+                ],
+                scratch_shapes=[
+                    pltpu.VMEM((block, hd), jnp.float32),
+                    pltpu.VMEM((block, hd), jnp.float32),
+                ],
+            ),
+            out_shape=[
+                _out_struct((BH, Tk, hd), k3.dtype, k3),
+                _out_struct((BH, Tk, hd), v3.dtype, v3),
+            ],
+            interpret=_interpret(),
+            **_call_kwargs(block),
+        )(jnp.asarray(im_c), jnp.asarray(jm_c),
+          q3, k3, v3, do3, out, lse, dlse)
+        return dq, dk, dv
 
     def q_row_idx(b, i, j):
+        return (b, i, 0)
+
+    def kv_row_idx(b, i, j):
+        return (b, j, 0)
+
+    def q_col_idx(b, j, i):
         return (b, i, 0)
 
     qspec = pl.BlockSpec((1, block, hd), q_row_idx,
@@ -287,7 +490,7 @@ def _bwd_impl(q3, k3, v3, out, lse, do3, dlse, block: int, causal: bool):
     kvspec = pl.BlockSpec((1, block, hd), kv_row_idx,
                           memory_space=pltpu.VMEM)
     dq = pl.pallas_call(
-        functools.partial(_dq_kernel, block=block, causal=causal),
+        functools.partial(_dq_kernel, block=block),
         grid=(BH, nq, nk),
         in_specs=[qspec, kvspec, kvspec, qspec, qspec, lspec, lspec],
         out_specs=pl.BlockSpec((1, block, hd), q_row_idx,
@@ -305,7 +508,7 @@ def _bwd_impl(q3, k3, v3, out, lse, do3, dlse, block: int, causal: bool):
     kspec = pl.BlockSpec((1, block, hd), lambda b, j, i: (b, j, 0),
                          memory_space=pltpu.VMEM)
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, block=block, causal=causal),
+        functools.partial(_dkv_kernel, block=block),
         grid=(BH, nk, nq),
         in_specs=[qcspec, kspec, kspec, qcspec, qcspec, lcspec, lcspec],
         out_specs=[kspec, kspec],

@@ -33,7 +33,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
-from jax import shard_map
+
+try:  # vma-aware shard_map (jax >= 0.6 exports it at top level)
+    from jax import shard_map
+except ImportError:  # older jax: the experimental module, same call shape
+    from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from distkeras_tpu.ops import rules
@@ -90,6 +94,12 @@ def lm_param_specs(params, tp_axis: Optional[str] = None,
             if parent == "qkv":  # kernel [D,3,H,hd], bias [3,H,hd]
                 return (P(None, None, tp_axis, None) if is_kernel
                         else P(None, tp_axis, None))
+            if parent == "q_proj":  # GQA: kernel [D,H,hd], bias [H,hd]
+                return (P(None, tp_axis, None) if is_kernel
+                        else P(tp_axis, None))
+            if parent == "kv_proj":  # kernel [D,2,Hk,hd], bias [2,Hk,hd]
+                return (P(None, None, tp_axis, None) if is_kernel
+                        else P(None, tp_axis, None))
             if parent == "out":  # kernel [H,hd,D], bias [D] (post-psum)
                 return P(tp_axis, None, None) if is_kernel else P()
             if parent == "mlp_up":  # kernel [D,F], bias [F]
@@ -104,6 +114,37 @@ def lm_param_specs(params, tp_axis: Optional[str] = None,
         return P()
 
     return tree_map_with_path(spec, params)
+
+
+def serving_cache_specs(cache, tp_axis: str = "model"):
+    """PartitionSpec tree for a decode-mode KV-cache pytree under tensor
+    parallelism — the serving-side twin of :func:`lm_param_specs`. Both
+    cache layouts shard the KV-head axis (dim 2):
+
+    - slot slabs ``cached_key/value [S, L, Hk, hd]`` and paged pools
+      ``paged_key/value [num_pages, bs, Hk, hd]`` → ``P(None, None, tp)``
+      (+ trailing None);
+    - int8 dequant scales ``key/value_scale [.., .., Hk]`` → same;
+    - cursor vectors (``cache_index``, ``pos_index``) stay replicated —
+      every shard advances the same host-owned positions.
+
+    Built by leaf *path* like :func:`lm_param_specs`, so it works on the
+    full-size (tp=1) cache template the engine allocates; ``shard_map``
+    then slices each leaf's KV heads onto the mesh."""
+    from jax.tree_util import DictKey, tree_map_with_path
+
+    sharded = {"cached_key", "cached_value", "paged_key", "paged_value",
+               "key_scale", "value_scale"}
+
+    def spec(path, leaf):
+        names = [k.key for k in path if isinstance(k, DictKey)]
+        last = names[-1] if names else ""
+        if last in sharded:
+            return P(*((None, None, tp_axis)
+                       + (None,) * (leaf.ndim - 3)))
+        return P()
+
+    return tree_map_with_path(spec, cache)
 
 
 def opt_state_specs(optimizer, params, param_specs):
@@ -174,6 +215,16 @@ def make_lm_train_step(model, optimizer, mesh: Mesh,
             "always shards the sequence over sp_axis; use a size-1 axis "
             "for the unsharded-sequence case (e.g. make_mesh({'dp': n, "
             "'sp': 1}))"
+        )
+    if fused_ce and not hasattr(jax.lax, "pcast"):
+        # the fused loss NEEDS the pcast below: its transpose is the psum
+        # that makes the custom-VJP head grads a correct replicated
+        # gradient. On pre-vma jax there is no pcast — running anyway
+        # would train with silently-unsummed head grads.
+        raise NotImplementedError(
+            "fused_ce=True needs vma-aware jax (jax.lax.pcast) for "
+            "correct replicated head gradients under shard_map; pass "
+            "fused_ce=False on this jax"
         )
     sp_size = int(np.prod([s for a, s in zip(mesh.axis_names, mesh.devices.shape)
                            if a == sp_axis] or [1]))

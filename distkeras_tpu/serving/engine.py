@@ -72,11 +72,12 @@ import sys
 import threading
 import time
 from dataclasses import dataclass
-from typing import List, Optional
+from typing import Any, List, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from distkeras_tpu import telemetry
 from distkeras_tpu.models.transformer import sample_tokens
@@ -93,24 +94,116 @@ from distkeras_tpu.serving.scheduler import (
 from distkeras_tpu.utils.metrics import MetricsWriter
 
 
+def _shard_map(body, mesh, in_specs, out_specs):
+    """shard_map across jax generations: the top-level export on newer
+    jax, the experimental module elsewhere. Replication/vma checking is
+    disabled either way — the serving bodies keep sampling on replicated
+    post-psum logits by construction, and the mesh-parity suite asserts
+    the streams, which is the check that matters (the training steps in
+    parallel/spmd.py keep strict checking; they differentiate, serving
+    doesn't)."""
+    try:
+        from jax import shard_map
+        try:
+            return shard_map(body, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+        except TypeError:  # a jax that renamed/dropped the kwarg
+            return shard_map(body, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs)
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+        return shard_map(body, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_rep=False)
+
+
+def _freeze(tree, is_leaf=None):
+    """Pytree -> hashable (treedef, leaves) so spec trees can ride the
+    lru_cache keys of the tick builders (compiled ticks stay shared
+    across engines with identical model/mesh/spec config, which is what
+    lets a warm engine pre-trace for a measured one)."""
+    leaves, treedef = jax.tree.flatten(tree, is_leaf=is_leaf)
+    return (treedef, tuple(leaves))
+
+
+def _thaw(frozen):
+    treedef, leaves = frozen
+    return jax.tree.unflatten(treedef, list(leaves))
+
+
+class _ShardCtx(NamedTuple):
+    """Hashable tensor-parallel context for the jitted serving bodies:
+    the mesh, its model axis, and frozen PartitionSpec trees for the
+    weight and cache pytrees (per lm_param_specs / serving_cache_specs —
+    Q/KV heads column-sharded, out/mlp_down row-sharded with one psum
+    per block, cache KV-head axis sharded, everything else replicated).
+    ``cache1`` is the frozen LOCAL (shape, dtype) tree for the B=1
+    scratch cache of the monolithic slot prefill — eval_shape of a
+    tp>1 module can't trace outside shard_map (unbound psum axis), so
+    the engine precomputes the per-shard shapes instead."""
+
+    mesh: Any
+    axis: str
+    pspec: Any
+    cspec: Any
+    cache1: Any = None
+
+    def spec(self, kind: str):
+        if kind == "p":
+            return _thaw(self.pspec)
+        if kind == "c":
+            return _thaw(self.cspec)
+        return P()
+
+
+def _compile(body, ctx: Optional[_ShardCtx], in_kinds: str,
+             out_kinds: str, donate):
+    """jit the tick/prefill ``body`` — plain (single-chip) when ``ctx``
+    is None, else under ``shard_map`` on the ctx's mesh with per-arg
+    specs by kind: 'p' = the weight spec tree, 'c' = the cache spec
+    tree, 'r' = replicated. All bodies keep sampling/logits/rng math on
+    replicated values, so every shard emits identical tokens and only
+    the weight/cache pytrees (and the head-sharded compute between
+    them) differ per device."""
+    if ctx is None:
+        return jax.jit(body, donate_argnums=donate)
+    return jax.jit(
+        _shard_map(
+            body, ctx.mesh,
+            tuple(ctx.spec(k) for k in in_kinds),
+            tuple(ctx.spec(k) for k in out_kinds),
+        ),
+        donate_argnums=donate,
+    )
+
+
 @functools.lru_cache(maxsize=64)
-def _prefill_fn(dm_one):
+def _prefill_fn(dm_one, ctx: Optional[_ShardCtx] = None):
     """Compiled per-slot prefill for a B=1 decode module: run the prompt
     through the ordinary prefill (writing a fresh B=1 cache), then
     scatter every cache leaf into row ``slot`` of the pooled cache.
     Cached per decode-module config; each distinct prompt length traces
-    its own prefill, exactly like ``generate``."""
+    its own prefill, exactly like ``generate``. Under a mesh (``ctx``)
+    the body runs per-shard on its KV-head slice; the scratch cache is
+    built from the ctx's precomputed LOCAL shapes (a tp module's init
+    can't eval_shape outside shard_map — unbound psum axis)."""
 
-    @functools.partial(jax.jit, donate_argnums=(1, 2))
+    @functools.partial(_compile, ctx=ctx, in_kinds="pcrrr",
+                       out_kinds="cr", donate=(1, 2))
     def prefill(params_only, pooled, last_logits, prompt, slot):
         recompiles.note("serve.prefill")
-        cache1 = jax.tree.map(
-            lambda s: jnp.zeros(s.shape, s.dtype),
-            jax.eval_shape(
-                dm_one.init, jax.random.PRNGKey(0),
-                jnp.zeros((1, 1), jnp.int32),
-            )["cache"],
-        )
+        if ctx is None:
+            cache1 = jax.tree.map(
+                lambda s: jnp.zeros(s.shape, s.dtype),
+                jax.eval_shape(
+                    dm_one.init, jax.random.PRNGKey(0),
+                    jnp.zeros((1, 1), jnp.int32),
+                )["cache"],
+            )
+        else:
+            cache1 = jax.tree.map(
+                lambda sd: jnp.zeros(sd[0], sd[1]), _thaw(ctx.cache1),
+                is_leaf=lambda x: isinstance(x, tuple),
+            )
         logits, vs = dm_one.apply(
             {**params_only, "cache": cache1}, prompt, mutable=["cache"]
         )
@@ -134,14 +227,18 @@ def _prefill_fn(dm_one):
 
 
 @functools.lru_cache(maxsize=256)
-def _tick_fn(dm_slot, cfgs):
+def _tick_fn(dm_slot, cfgs, ctx: Optional[_ShardCtx] = None):
     """Compiled decode tick for one per-slot sampling-config tuple
     ``cfgs = ((temperature, top_k, top_p), ...)``: sample one token per
     slot (each from its own RNG chain, on a ``[1, vocab]`` logits slice —
     the exact call shape of a solo B=1 ``generate``, so streams are
-    token-identical), then advance all slots one decode step."""
+    token-identical), then advance all slots one decode step. With a
+    mesh ``ctx`` the same body runs under shard_map: sampling happens on
+    the replicated post-psum logits (every shard draws the identical
+    token), the decode step on each shard's head slice."""
 
-    @functools.partial(jax.jit, donate_argnums=(1, 2, 3))
+    @functools.partial(_compile, ctx=ctx, in_kinds="pcrr",
+                       out_kinds="crrr", donate=(1, 2, 3))
     def tick(params_only, cache, last_logits, rngs):
         recompiles.note("serve.tick")
         toks, new_rngs = [], []
@@ -163,7 +260,7 @@ def _tick_fn(dm_slot, cfgs):
 
 
 @functools.lru_cache(maxsize=64)
-def _paged_prefill_fn(dm_paged):
+def _paged_prefill_fn(dm_paged, ctx: Optional[_ShardCtx] = None):
     """Compiled paged prefill: run the prompt's UNCACHED suffix at B=1
     against the shared block pool — the row's block table maps each
     suffix position into blocks this row owns, and cached prefix
@@ -171,7 +268,8 @@ def _paged_prefill_fn(dm_paged):
     request computed them first). The cache IS the global pool, so
     unlike the slot path there is no per-slot scatter-merge step."""
 
-    @functools.partial(jax.jit, donate_argnums=(1, 2))
+    @functools.partial(_compile, ctx=ctx, in_kinds="pcrrrrr",
+                       out_kinds="cr", donate=(1, 2))
     def prefill(params_only, cache, last_logits, suffix, table, start,
                 slot):
         recompiles.note("serve.paged_prefill")
@@ -188,7 +286,7 @@ def _paged_prefill_fn(dm_paged):
 
 
 @functools.lru_cache(maxsize=256)
-def _mixed_tick_fn(dm_slot, cfgs, chunk):
+def _mixed_tick_fn(dm_slot, cfgs, chunk, ctx: Optional[_ShardCtx] = None):
     """Compiled CHUNKED mixed prefill/decode tick (the Sarathi-style
     fused step): one ``[S, chunk]`` dispatch advances every slot —
     decoding rows consume 1 valid token (their own freshly-sampled
@@ -199,9 +297,14 @@ def _mixed_tick_fn(dm_slot, cfgs, chunk):
     ticks must not burn the chain that makes streams token-identical to
     solo ``generate()``. Logits are taken at each row's LAST VALID
     token, so the tick that feeds a prompt's final chunk leaves exactly
-    the logits a monolithic prefill would have."""
+    the logits a monolithic prefill would have. A mesh ``ctx`` runs the
+    identical body per head-shard under shard_map — the ``[S, C]``
+    chunk semantics (absolute per-row positions, valid-length writes,
+    RNG discipline) are untouched, so sharded streams stay
+    bit-identical to the single-chip path."""
 
-    @functools.partial(jax.jit, donate_argnums=(1, 2, 3))
+    @functools.partial(_compile, ctx=ctx, in_kinds="pcrrrrr",
+                       out_kinds="crrr", donate=(1, 2, 3))
     def tick(params_only, cache, last_logits, rngs, fed, valid,
              sample_mask):
         recompiles.note("serve.mixed_tick")
@@ -232,13 +335,15 @@ def _mixed_tick_fn(dm_slot, cfgs, chunk):
 
 
 @functools.lru_cache(maxsize=256)
-def _paged_mixed_tick_fn(dm_paged, cfgs, chunk):
+def _paged_mixed_tick_fn(dm_paged, cfgs, chunk,
+                         ctx: Optional[_ShardCtx] = None):
     """Paged twin of :func:`_mixed_tick_fn`: same fused
     sample/feed/advance semantics, with K/V reads and writes routed
     through each row's block table (chunk padding lands in the reserved
     trash block)."""
 
-    @functools.partial(jax.jit, donate_argnums=(1, 2, 3))
+    @functools.partial(_compile, ctx=ctx, in_kinds="pcrrrrrrr",
+                       out_kinds="crrr", donate=(1, 2, 3))
     def tick(params_only, cache, last_logits, rngs, tables, lens, fed,
              valid, sample_mask):
         recompiles.note("serve.paged_mixed_tick")
@@ -282,12 +387,13 @@ def _reset_slot_cursors(cache, slot):
 
 
 @functools.lru_cache(maxsize=256)
-def _paged_tick_fn(dm_paged, cfgs):
+def _paged_tick_fn(dm_paged, cfgs, ctx: Optional[_ShardCtx] = None):
     """Paged twin of :func:`_tick_fn`: identical per-slot sampling (same
     RNG chains, same [1, vocab] call shape), then one decode step whose
     K/V reads/writes go through each row's block table."""
 
-    @functools.partial(jax.jit, donate_argnums=(1, 2, 3))
+    @functools.partial(_compile, ctx=ctx, in_kinds="pcrrrr",
+                       out_kinds="crrr", donate=(1, 2, 3))
     def tick(params_only, cache, last_logits, rngs, tables, lens):
         recompiles.note("serve.paged_tick")
         toks, new_rngs = [], []
@@ -394,6 +500,28 @@ class ServingEngine:
       flight_capacity: ring size in ticks for the engine-owned recorder.
       postmortem_dir: where crash/stall dumps land (default ``/tmp``,
         the path CI uploads on tier-1 failure).
+      mesh: a 1-D device mesh (``make_mesh({"model": n})``) to run the
+        jitted tick bodies tensor-parallel under ``shard_map``: Q/KV
+        projections column-sharded and out-projections row-sharded per
+        :func:`~distkeras_tpu.parallel.spmd.lm_param_specs` (one psum
+        per block), the KV cache sharded along its head axis per
+        :func:`~distkeras_tpu.parallel.spmd.serving_cache_specs`.
+        Sampling/logits/RNG stay replicated, so token streams are
+        bit-identical to the single-chip engine (asserted by
+        tests/test_tp_serving.py on forced host devices). Host-side
+        state — scheduler, BlockPool, RadixPrefixIndex, flight
+        recorder — is untouched: only the weight/cache pytrees and the
+        compiled tick bodies gain shardings. Pass the TRAINING-mode
+        ``tp_size=1`` model as always; the engine clones tp twins.
+        ``num_kv_heads`` (or ``num_heads``) must divide by the mesh
+        size.
+      tp_axis: the mesh axis name to shard heads over (default
+        ``"model"``).
+      paged_kernel: paged attend implementation — 'auto' (the Pallas
+        paged-attention kernel of :mod:`distkeras_tpu.ops.paged_attention`
+        where the shape tiles on this backend, else the gathered
+        reference), 'pallas' (force; interpret mode off-TPU), 'gather'
+        (force the reference). Paged mode only.
 
     Drive it with :meth:`step` (one admit→tick→complete→refill cycle,
     e.g. from a test) or :meth:`serve_forever` (the TCP front-end's
@@ -412,7 +540,9 @@ class ServingEngine:
                  prefix_cache: bool = True,
                  prefill_chunk: Optional[int] = DEFAULT_PREFILL_CHUNK,
                  flight=True, flight_capacity: int = 512,
-                 postmortem_dir: str = "/tmp"):
+                 postmortem_dir: str = "/tmp",
+                 mesh=None, tp_axis: str = "model",
+                 paged_kernel: str = "auto"):
         if slots < 1:
             raise ValueError(f"slots must be >= 1; got {slots}")
         if prefill_chunk is not None and prefill_chunk < 1:
@@ -422,6 +552,35 @@ class ServingEngine:
             )
         self.prefill_chunk = prefill_chunk
         self._admit_seq = 0
+        # tensor-parallel serving: a 1-D mesh shards the jitted tick
+        # bodies (weights + cache) over tp_axis; everything host-side
+        # stays single-process
+        self.mesh = mesh
+        self.tp_axis = tp_axis
+        if mesh is not None:
+            sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+            if tp_axis not in sizes:
+                raise ValueError(
+                    f"mesh axes {mesh.axis_names} have no '{tp_axis}' "
+                    f"axis — build the serving mesh as "
+                    f"make_mesh({{'{tp_axis}': n}})"
+                )
+            if any(s > 1 for a, s in sizes.items() if a != tp_axis):
+                raise ValueError(
+                    f"the serving mesh must be 1-D over '{tp_axis}' "
+                    f"(got {sizes}): the engine shards heads only — "
+                    f"batch parallelism is the router's job, one engine "
+                    f"per replica"
+                )
+            if getattr(model, "tp_size", 1) != 1:
+                raise ValueError(
+                    "pass the training-mode (tp_size=1) model; the "
+                    "engine clones tensor-parallel decode twins for the "
+                    "mesh itself"
+                )
+            self.tp = sizes[tp_axis]
+        else:
+            self.tp = 1
         # flight recorder: True = own recorder (the default — its
         # self-measured overhead is reported in stats()["flight"] and
         # bounded by serve_bench's smoke assert), a FlightRecorder to
@@ -473,16 +632,27 @@ class ServingEngine:
                                   registry=self.registry)
             self.prefix = (RadixPrefixIndex(block_size)
                            if prefix_cache else None)
-            self._dm_paged = self.model.clone(
+            paged_kw = dict(
                 decode=True, paged=True, page_block_size=block_size,
-                num_pages=num_blocks, parent=None,
+                num_pages=num_blocks, paged_kernel=paged_kernel,
+                parent=None,
             )
+            self._dm_paged = self.model.clone(
+                **paged_kw,
+                **({"tp_size": self.tp, "tp_axis": tp_axis}
+                   if mesh is not None else {}),
+            )
+            # cache template is always the GLOBAL (tp=1) layout; under a
+            # mesh, device_put + the cache specs slice the KV-head axis
+            # (a tp module's init can't trace outside shard_map)
+            dm_tpl = (self._dm_paged if mesh is None
+                      else self.model.clone(**paged_kw))
             self._cache = jax.tree.map(
                 lambda s: jnp.zeros(s.shape, s.dtype),
                 jax.eval_shape(
                     # keywords: init's positional slot after tokens is
                     # `train`, not block_tables
-                    lambda r, t, bt, sl: self._dm_paged.init(
+                    lambda r, t, bt, sl: dm_tpl.init(
                         r, t, block_tables=bt, seq_lens=sl
                     ),
                     jax.random.PRNGKey(0),
@@ -500,14 +670,21 @@ class ServingEngine:
         else:
             self.pool = None
             self.prefix = None
+            tp_kw = ({"tp_size": self.tp, "tp_axis": tp_axis}
+                     if mesh is not None else {})
             self._dm_slot = self.model.clone(
-                decode=True, slot_cursor=True, parent=None
+                decode=True, slot_cursor=True, parent=None, **tp_kw
             )
-            self._dm_one = self.model.clone(decode=True, parent=None)
+            self._dm_one = self.model.clone(decode=True, parent=None,
+                                            **tp_kw)
+            dm_tpl = (self._dm_slot if mesh is None
+                      else self.model.clone(decode=True,
+                                            slot_cursor=True,
+                                            parent=None))
             self._cache = jax.tree.map(
                 lambda s: jnp.zeros(s.shape, s.dtype),
                 jax.eval_shape(
-                    self._dm_slot.init, jax.random.PRNGKey(0),
+                    dm_tpl.init, jax.random.PRNGKey(0),
                     jnp.zeros((slots, 1), jnp.int32),
                 )["cache"],
             )
@@ -515,6 +692,9 @@ class ServingEngine:
             (slots, self.model.vocab_size), jnp.float32
         )
         self._rngs = jnp.zeros((slots, 2), jnp.uint32)
+        self._ctx: Optional[_ShardCtx] = None
+        if mesh is not None:
+            self._init_mesh_ctx()
         self._slots: List[Optional[_SlotState]] = [None] * slots
         # counters (host-side observability; per-engine, unlike the
         # process-cumulative registry series)
@@ -524,6 +704,64 @@ class ServingEngine:
         self.prompt_tokens = 0
         self.prefix_hit_tokens = 0
         self._occ_sum = 0
+
+    def _init_mesh_ctx(self):
+        """Shard the device-side engine state onto the mesh and build
+        the hashable :class:`_ShardCtx` the tick builders key on:
+        weights per ``lm_param_specs`` (Q/KV column-sharded, out-proj
+        row-sharded — one psum per block), the cache's KV-head axis per
+        ``serving_cache_specs``, logits/RNG chains replicated. For the
+        monolithic slot prefill, precompute the per-shard shapes of its
+        B=1 scratch cache (its in-body eval_shape can't trace a tp
+        module outside shard_map)."""
+        from distkeras_tpu.parallel.spmd import (
+            lm_param_specs,
+            serving_cache_specs,
+        )
+
+        mesh, axis = self.mesh, self.tp_axis
+        is_p = lambda x: isinstance(x, P)  # noqa: E731
+
+        def named(spec_tree):
+            return jax.tree.map(lambda s: NamedSharding(mesh, s),
+                                spec_tree, is_leaf=is_p)
+
+        pspec = lm_param_specs(self._params_only, tp_axis=axis)
+        cspec = serving_cache_specs(self._cache, tp_axis=axis)
+        self._params_only = jax.device_put(self._params_only,
+                                           named(pspec))
+        self._cache = jax.device_put(self._cache, named(cspec))
+        rep = NamedSharding(mesh, P())
+        self._last_logits = jax.device_put(self._last_logits, rep)
+        self._rngs = jax.device_put(self._rngs, rep)
+        cache1 = None
+        if not self.paged:
+            dm_one_tpl = self.model.clone(decode=True, parent=None)
+            c1 = jax.eval_shape(
+                dm_one_tpl.init, jax.random.PRNGKey(0),
+                jnp.zeros((1, 1), jnp.int32),
+            )["cache"]
+            c1spec = serving_cache_specs(c1, tp_axis=axis)
+            leaves, treedef = jax.tree.flatten(c1)
+            spec_leaves = jax.tree.flatten(c1spec, is_leaf=is_p)[0]
+
+            def local(shape, spec):
+                out = list(shape)
+                for i, name in enumerate(spec):
+                    if name == axis:
+                        out[i] //= self.tp
+                return tuple(out)
+
+            cache1 = (treedef, tuple(
+                (local(l.shape, s), np.dtype(l.dtype))
+                for l, s in zip(leaves, spec_leaves)
+            ))
+        self._ctx = _ShardCtx(
+            mesh=mesh, axis=axis,
+            pspec=_freeze(pspec, is_leaf=is_p),
+            cspec=_freeze(cspec, is_leaf=is_p),
+            cache1=cache1,
+        )
 
     def _wire_metrics(self):
         """Register this engine's metric handles (get-or-create: many
@@ -820,7 +1058,7 @@ class ServingEngine:
             # every live decode stream waits it out (the ITL spike
             # chunked prefill exists to remove)
             self._m_decode_stalls.inc()
-        prefill = _prefill_fn(self._dm_one)
+        prefill = _prefill_fn(self._dm_one, self._ctx)
         prompt = jnp.asarray(req.prompt, jnp.int32)[None]
         t0 = time.perf_counter()
         self._cache, self._last_logits = prefill(
@@ -879,7 +1117,7 @@ class ServingEngine:
         suffix = jnp.asarray(req.prompt[cached:], jnp.int32)[None]
         table = np.zeros((1, self._max_blocks), np.int32)
         table[0, :len(chain)] = chain
-        prefill = _paged_prefill_fn(self._dm_paged)
+        prefill = _paged_prefill_fn(self._dm_paged, self._ctx)
         t0 = time.perf_counter()
         self._cache, self._last_logits = prefill(
             self._params_only, self._cache, self._last_logits,
@@ -996,7 +1234,8 @@ class ServingEngine:
         t0 = time.perf_counter()
         plan_ms = (t0 - t_plan0) * 1e3
         if self.paged:
-            tick = _paged_mixed_tick_fn(self._dm_paged, cfgs, C)
+            tick = _paged_mixed_tick_fn(self._dm_paged, cfgs, C,
+                                        self._ctx)
             self._cache, self._last_logits, toks, self._rngs = tick(
                 self._params_only, self._cache, self._last_logits,
                 self._rngs, jnp.asarray(self._block_tables),
@@ -1012,7 +1251,7 @@ class ServingEngine:
                     adv[s] = 1 if st.decoding else valid[s]
             self._seq_lens = self._seq_lens + adv
         else:
-            tick = _mixed_tick_fn(self._dm_slot, cfgs, C)
+            tick = _mixed_tick_fn(self._dm_slot, cfgs, C, self._ctx)
             self._cache, self._last_logits, toks, self._rngs = tick(
                 self._params_only, self._cache, self._last_logits,
                 self._rngs, jnp.asarray(fed), jnp.asarray(valid),
@@ -1099,7 +1338,7 @@ class ServingEngine:
         t0 = time.perf_counter()
         plan_ms = (t0 - t_plan0) * 1e3
         if self.paged:
-            tick = _paged_tick_fn(self._dm_paged, cfgs)
+            tick = _paged_tick_fn(self._dm_paged, cfgs, self._ctx)
             self._cache, self._last_logits, toks, self._rngs = tick(
                 self._params_only, self._cache, self._last_logits,
                 self._rngs, jnp.asarray(self._block_tables),
@@ -1115,7 +1354,7 @@ class ServingEngine:
             )
             self._seq_lens = self._seq_lens + alive.astype(np.int32)
         else:
-            tick = _tick_fn(self._dm_slot, cfgs)
+            tick = _tick_fn(self._dm_slot, cfgs, self._ctx)
             self._cache, self._last_logits, toks, self._rngs = tick(
                 self._params_only, self._cache, self._last_logits,
                 self._rngs
@@ -1358,6 +1597,8 @@ class ServingEngine:
             "recompiles": recompiles.counts(),
             "recompiles_since_mark": self.recompiles_since_mark(),
             "memory": self._mem.summary(),
+            # tensor-parallel degree of the tick bodies (1 = single-chip)
+            "tp": self.tp,
         }
         if self.flight is not None:
             out["flight"] = {

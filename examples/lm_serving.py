@@ -32,8 +32,15 @@ Prompts stream into their slots chunk-by-chunk inside the decode tick
 restores the legacy monolithic whole-prompt prefill dispatch) — a long
 prompt never stalls the tokens already streaming.
 
+``--tp N`` serves tensor-parallel: the jitted tick bodies run under
+``shard_map`` on a 1-D ``model`` mesh over N devices — attention heads
+and MLP hidden sharded, one psum per block, the KV cache split along its
+head axis. Streams stay bit-identical to single-chip serving (the
+parity check below covers it). Needs N local devices (real chips, or
+``XLA_FLAGS=--xla_force_host_platform_device_count=N`` on CPU).
+
 Run: python examples/lm_serving.py [--prompts 4] [--max-new 16] [--slots 2]
-     [--telemetry-port 9100] [--paged] [--prefill-chunk 16]
+     [--telemetry-port 9100] [--paged] [--prefill-chunk 16] [--tp 2]
      [--flight-dump /tmp/flight.jsonl]
 """
 
@@ -76,6 +83,10 @@ def main():
                     help="write the flight-recorder ring to this JSONL "
                          "when done (render: python -m "
                          "distkeras_tpu.telemetry.report --flight PATH)")
+    ap.add_argument("--tp", type=int, default=1,
+                    help="tensor-parallel serving over this many "
+                         "devices (1-D 'model' mesh; heads must "
+                         "divide)")
     args = ap.parse_args()
 
     model = get_model(
@@ -118,6 +129,12 @@ def main():
         max_len = args.prompt_len + args.max_new
         bs = next(b for b in (8, 4, 2, 1) if max_len % b == 0)
         engine_kw.update(paged=True, block_size=bs)
+    if args.tp > 1:
+        from distkeras_tpu.parallel.mesh import make_mesh
+
+        engine_kw["mesh"] = make_mesh({"model": args.tp})
+        print(f"tensor-parallel serving: tp={args.tp} over "
+              f"{args.tp} of {len(jax.devices())} devices")
     engine = ServingEngine(model, params, slots=args.slots, **engine_kw)
     # SLO monitor (default serving rules) + stall watchdog: the server
     # starts/stops both; alerts are served over the TCP "alerts" op

@@ -150,6 +150,10 @@ def main():
             max_len=args.seq_len, moe_experts=args.experts,
             moe_top_k=args.top_k, ep_size=args.ep, ep_axis="ep",
             pos_emb="rope" if args.rope else "sinusoidal",
+            # MoeLM shares the TransformerLM attention stack, so GQA
+            # composes with expert routing; dropping the flag here
+            # silently trained MHA under a --kv-heads command line
+            num_kv_heads=args.kv_heads,
         )
     else:
         model = get_model(

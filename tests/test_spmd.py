@@ -182,3 +182,55 @@ def test_lm_window_step_matches_sequential_steps():
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6
         )
+
+
+def test_lm_param_specs_gqa_tp_shardable():
+    """ADVICE round-5: lm_param_specs used to silently replicate the
+    GQA q_proj/kv_proj projections (they post-date the qkv/out/mlp
+    branches), so a GQA model under tp sharded its MLP but REPLICATED
+    its attention weights — and the tp decode twins then saw global
+    head counts per shard. Every attention/MLP kernel of a GQA model
+    must now carry the tp axis on the dim TPDenseGeneral shards, and
+    every spec'd dim must divide by the mesh size."""
+    from jax.tree_util import keystr, tree_leaves_with_path
+
+    from distkeras_tpu.parallel.spmd import lm_param_specs
+    from jax.sharding import PartitionSpec as P
+
+    tp = 4
+    model = get_model(
+        "transformer_lm", vocab_size=64, d_model=32, num_heads=8,
+        num_kv_heads=4, num_layers=2, max_len=32, dtype=jnp.float32,
+    )
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))
+    specs = lm_param_specs(params, tp_axis="tp")
+    flat_specs = dict(
+        (keystr(k), v) for k, v in
+        tree_leaves_with_path(specs, is_leaf=lambda x: isinstance(x, P))
+    )
+    want = {
+        # col-sharded: q_proj over H (features dim 0 -> kernel dim 1),
+        # kv_proj over Hk (features dim 1 -> kernel dim 2)
+        "q_proj.*kernel": P(None, "tp", None),
+        "q_proj.*bias": P("tp", None),
+        "kv_proj.*kernel": P(None, None, "tp", None),
+        "kv_proj.*bias": P(None, "tp", None),
+        # row-sharded out-proj consumes the local heads, psums out
+        "out.*kernel": P("tp", None, None),
+        "mlp_up.*kernel": P(None, "tp"),
+        "mlp_down.*kernel": P("tp", None),
+    }
+    import re
+    seen = set()
+    for key, leaf in tree_leaves_with_path(params):
+        spec = flat_specs[keystr(key)]
+        for pat, expected in want.items():
+            if re.search(pat, keystr(key)):
+                assert spec == expected, (keystr(key), spec)
+                seen.add(pat)
+        # shardability: every spec'd dim divides by the mesh size
+        for dim, name in enumerate(spec):
+            if name is not None:
+                assert leaf.shape[dim] % tp == 0, (keystr(key), spec)
+    assert seen == set(want), f"missing param families: {set(want) - seen}"
