@@ -313,6 +313,7 @@ def main():
     del params, opt_state, x, y
     out.update(lm_bench())
     out.update(serve_interference_bench())
+    out.update(serve_speculative_bench())
     print(json.dumps(out))
 
 
@@ -347,6 +348,38 @@ def serve_interference_bench():
         }
     except Exception as e:  # pragma: no cover - accelerator-dependent
         return {"serve_itl_error": f"{type(e).__name__}: {e}"}
+
+
+def serve_speculative_bench():
+    """Speculative-decoding serving numbers for the BENCH trajectory:
+    decode tok/s and client-side ITL, n-gram drafter vs plain mixed
+    ticks at high acceptance. Self-asserts are off (``checks=False``)
+    and errors are folded into the JSON, same policy as the
+    interference line."""
+    import os
+    import sys
+
+    sys.path.insert(
+        0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "benchmarks"))
+    try:
+        import serve_bench
+
+        r = serve_bench.bench_speculative(smoke=True, checks=False)
+        return {
+            "serve_spec_decode_speedup": r["decode_speedup"],
+            "serve_spec_tokens_per_sec": r["spec_tokens_per_sec"],
+            "serve_spec_baseline_tokens_per_sec":
+                r["baseline_tokens_per_sec"],
+            "serve_spec_itl_ms_p50": r["spec_itl_ms_p50"],
+            "serve_spec_baseline_itl_ms_p50": r["baseline_itl_ms_p50"],
+            "serve_spec_acceptance_rate": r["acceptance_rate"],
+            "serve_spec_accept_len": r["accept_len"],
+            "serve_spec_parity": r["parity"],
+            "serve_spec_config": r["config"],
+        }
+    except Exception as e:  # pragma: no cover - accelerator-dependent
+        return {"serve_spec_error": f"{type(e).__name__}: {e}"}
 
 
 if __name__ == "__main__":

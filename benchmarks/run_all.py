@@ -366,8 +366,36 @@ def config10():
     }))
 
 
+def config11():
+    """Speculative decoding inside the mixed tick: decode tok/s and
+    client-side ITL with the n-gram drafter vs the plain engine at high
+    acceptance (benchmarks/serve_bench.py --speculative; the --smoke
+    variant self-asserts greedy bit-parity, >=1.5x decode tok/s, p50
+    ITL <= baseline, and zero steady-state recompiles)."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import serve_bench
+
+    out = serve_bench.bench_speculative(smoke=True)
+    print(json.dumps({
+        "config": 11, "metric": "serving_speculative_decode_speedup",
+        "value": out["decode_speedup"],
+        "unit": "x (decode tok/s, spec / baseline)",
+        "spec_tokens_per_sec": out["spec_tokens_per_sec"],
+        "baseline_tokens_per_sec": out["baseline_tokens_per_sec"],
+        "spec_itl_ms_p50": out["spec_itl_ms_p50"],
+        "baseline_itl_ms_p50": out["baseline_itl_ms_p50"],
+        "acceptance_rate": out["acceptance_rate"],
+        "accept_len": out["accept_len"],
+        "parity": out["parity"],
+        "steady_recompiles": out["spec_steady_recompiles"],
+        "model": out["config"],
+        "data": "synthetic-periodic-overfit-trace",
+    }))
+
+
 CONFIGS = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5,
-           6: config6, 7: config7, 8: config8, 9: config9, 10: config10}
+           6: config6, 7: config7, 8: config8, 9: config9, 10: config10,
+           11: config11}
 
 
 def main():

@@ -560,6 +560,247 @@ def bench_long_prompt_interference(
     return result
 
 
+def _overfit_cycle(model, params, corpus, train_steps, T=32, B=8,
+                   lr=1e-3, seed=0):
+    """Overfit ``model`` on a periodic token stream (a few seconds of
+    jitted Adam on CPU). This manufactures the speculative bench's
+    HIGH-ACCEPTANCE regime honestly: a model that has learned strong
+    local structure emits the same repetitive continuations a real LM
+    emits on repetitive text (code, templated prose) — exactly the
+    workload where a drafter's proposals survive verification. Random
+    untrained weights can't exhibit that (greedy streams wander, the
+    n-gram drafter's acceptance sits near 0.3), so without this step
+    the bench could only measure the LOW-acceptance regime."""
+    import optax
+
+    opt = optax.adam(lr)
+    ostate = opt.init(params)
+
+    @jax.jit
+    def step(params, ostate, xy):
+        def loss(p):
+            logits = model.apply(p, xy[:, :-1])
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, xy[:, 1:]).mean()
+
+        l, g = jax.value_and_grad(loss)(params)
+        up, ostate = opt.update(g, ostate)
+        return optax.apply_updates(params, up), ostate, l
+
+    key = jax.random.PRNGKey(seed)
+    for _ in range(train_steps):
+        key, sub = jax.random.split(key)
+        starts = np.asarray(
+            jax.random.randint(sub, (B,), 0, len(corpus) - T - 1))
+        xy = jnp.stack([jnp.asarray(corpus[s:s + T + 1]) for s in starts])
+        params, ostate, l = step(params, ostate, xy)
+    return params, float(l)
+
+
+def bench_speculative(V=64, D=512, H=8, L=4, slots=4, n_requests=12,
+                      max_new=48, spec_k=4, prefill_chunk=32,
+                      tick_token_budget=None, train_steps=150, period=8,
+                      draft="ngram", dtype="float32", smoke=False,
+                      checks=True):
+    """Speculative decoding vs the plain mixed tick at high acceptance:
+    decode tokens/sec and client-side ITL p50/p99 on a staggered-length
+    trace, same engine config with and without a drafter.
+
+    The flagship is first overfit on a ``period``-token cycle
+    (:func:`_overfit_cycle`) so its greedy streams carry the strong
+    local structure speculation feeds on; prompts are rotations of the
+    cycle, output lengths staggered so completions never line up. Each
+    request's tokens are timestamped by its own consumer thread — ITL
+    gaps are exact and client-visible (a verify tick releases an
+    accepted prefix as a burst: intra-burst gaps collapse toward zero,
+    which is the speculation win as a CLIENT sees it). ``draft`` picks
+    the drafter: ``"ngram"`` (self-speculative suffix lookup, no second
+    model) or ``"model"`` (a ~100x-smaller TransformerLM overfit on the
+    same corpus — the classic two-model setup). ``--smoke`` self-asserts
+    greedy bit-parity spec-vs-baseline, p50 ITL <= baseline, >= 1.5x
+    decode tok/s, populated acceptance telemetry, and zero steady-state
+    recompiles."""
+    from distkeras_tpu import telemetry
+    from distkeras_tpu.models import get_model
+    from distkeras_tpu.serving import FIFOScheduler, ServingEngine
+    from distkeras_tpu.telemetry.exposition import render_prometheus
+
+    if smoke:
+        V, D, H, L, slots = 64, 256, 4, 2, 3
+        n_requests, max_new, train_steps = 6, 32, 80
+    if tick_token_budget is None:
+        tick_token_budget = slots * (spec_k + 1) + prefill_chunk
+    rng = np.random.default_rng(7)
+    cycle = rng.integers(0, V, size=period).astype(np.int32)
+    corpus = np.tile(cycle, 64)
+    max_len = 2 * period + max_new + spec_k + 1
+    max_len += (-max_len) % 16
+    model = get_model(
+        "transformer_lm", vocab_size=V, d_model=D, num_heads=H,
+        num_layers=L, max_len=max_len, dtype=jnp.dtype(dtype),
+        attention="dense",
+    )
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 4), jnp.int32))
+    t0 = time.perf_counter()
+    params, loss = _overfit_cycle(model, params, corpus, train_steps)
+    train_s = time.perf_counter() - t0
+    draft_kw = {"draft": "ngram"}
+    if draft == "model":
+        dmodel = get_model(
+            "transformer_lm", vocab_size=V, d_model=32, num_heads=2,
+            num_layers=1, max_len=max_len, dtype=jnp.dtype(dtype),
+            attention="dense",
+        )
+        dparams = dmodel.init(jax.random.PRNGKey(1),
+                              jnp.zeros((1, 4), jnp.int32))
+        dparams, _ = _overfit_cycle(dmodel, dparams, corpus,
+                                    train_steps, seed=1)
+        draft_kw = {"draft": dmodel, "draft_params": dparams}
+    lens = rng.integers(max(4, max_new // 2), max_new + 1,
+                        size=n_requests)
+    prompts = [np.concatenate([cycle, cycle[:int(o)]]).astype(np.int32)
+               for o in rng.integers(1, period, size=n_requests)]
+
+    def run(spec):
+        def make_engine():
+            return ServingEngine(
+                model, params, slots=slots,
+                registry=telemetry.MetricRegistry(),
+                tracer=telemetry.Tracer(), prefill_chunk=prefill_chunk,
+                scheduler=FIFOScheduler(
+                    tick_token_budget=tick_token_budget,
+                    registry=telemetry.MetricRegistry(),
+                    tracer=telemetry.Tracer()),
+                **({**draft_kw, "spec_k": spec_k} if spec else {}),
+            )
+
+        # warm a throwaway engine through every shape (jit caches key
+        # on module config, so the measured engine reuses the traces)
+        warm = make_engine()
+        for p, m in zip(prompts, lens):
+            warm.submit(p, max_new_tokens=int(m))
+        warm.drain()
+
+        engine = make_engine()
+        registry = engine.registry
+        engine.mark_steady()
+
+        # pass 1 — throughput: submit everything, drain, read streams
+        # afterwards. No consumer threads contend for the GIL, so the
+        # number is the engine's sustained decode rate. Best of 3
+        # replays: the window is short, and on a shared CPU runner a
+        # scheduler hiccup inside it swamps the effect being measured.
+        best = 0.0
+        for _ in range(3):
+            reqs = [engine.submit(p, max_new_tokens=int(m))
+                    for p, m in zip(prompts, lens)]
+            t0 = time.perf_counter()
+            engine.drain()
+            dt = time.perf_counter() - t0
+            streams = [r.stream.tokens(timeout=300) for r in reqs]
+            total = sum(map(len, streams))
+            best = max(best, total / dt)
+
+        # pass 2 — client-side ITL: one consumer thread per request
+        # timestamps every token as it crosses the stream boundary (a
+        # verify tick releases its accepted prefix as a burst — the
+        # intra-burst gaps collapsing toward zero IS the speculation
+        # win as a client sees it).
+        stop = threading.Event()
+        loop = threading.Thread(target=engine.serve_forever,
+                                args=(stop,), daemon=True)
+        lock = threading.Lock()
+        itls = []
+
+        def consume(req):
+            stamps = [time.perf_counter() for _ in req.stream]
+            with lock:
+                itls.extend(
+                    (b - a) * 1e3 for a, b in zip(stamps, stamps[1:]))
+
+        loop.start()
+        threads = []
+        for p, m in zip(prompts, lens):
+            r = engine.submit(p, max_new_tokens=int(m))
+            t = threading.Thread(target=consume, args=(r,),
+                                 daemon=True)
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join(timeout=300)
+        stop.set()
+        loop.join(timeout=10)
+        with lock:
+            vals = sorted(itls)
+        stats = engine.stats()
+        return {
+            "streams": streams,
+            "tokens_per_sec": round(best, 1),
+            "itl_ms_p50": vals[int(0.50 * (len(vals) - 1))]
+            if vals else None,
+            "itl_ms_p99": vals[int(0.99 * (len(vals) - 1))]
+            if vals else None,
+            "acceptance_rate": stats.get("acceptance_rate"),
+            "accept_len": registry.histogram("serving_accept_len").value,
+            "steady_recompiles": stats["recompiles_since_mark"],
+            "flight_overhead_frac": stats["flight"]["overhead_frac"],
+            "memory": stats["memory"],
+            "exposition": render_prometheus(registry),
+        }
+
+    spec = run(True)
+    base = run(False)
+    result = {
+        "spec_tokens_per_sec": spec["tokens_per_sec"],
+        "baseline_tokens_per_sec": base["tokens_per_sec"],
+        "decode_speedup": (
+            round(spec["tokens_per_sec"] / base["tokens_per_sec"], 2)
+            if base["tokens_per_sec"] else None
+        ),
+        "spec_itl_ms_p50": spec["itl_ms_p50"],
+        "baseline_itl_ms_p50": base["itl_ms_p50"],
+        "spec_itl_ms_p99": spec["itl_ms_p99"],
+        "baseline_itl_ms_p99": base["itl_ms_p99"],
+        "acceptance_rate": spec["acceptance_rate"],
+        "accept_len": spec["accept_len"],
+        "parity": spec["streams"] == base["streams"],
+        "spec_steady_recompiles": spec["steady_recompiles"],
+        "baseline_steady_recompiles": base["steady_recompiles"],
+        "flight_overhead_frac": spec["flight_overhead_frac"],
+        "memory": spec["memory"],
+        "train_s": round(train_s, 1),
+        "train_loss": round(loss, 5),
+        "config": f"d{D}/h{H}/L{L}/v{V}-slots{slots}"
+                  f"-req{n_requests}-new{max_new}-k{spec_k}"
+                  f"-draft{draft}-period{period}"
+                  f"-chunk{prefill_chunk}-budget{tick_token_budget}"
+                  f"-{dtype}" + ("-smoke" if smoke else ""),
+    }
+    if smoke and checks:
+        # CI drift guards: speculation must not perturb a single greedy
+        # token, must actually be faster at high acceptance (the >=1.5x
+        # floor is the ISSUE's headline; the measured smoke sits ~2.5x,
+        # so this survives CI jitter), must populate the acceptance
+        # telemetry, and must never re-trace in steady state
+        assert result["parity"], result
+        assert result["decode_speedup"] >= 1.5, result
+        assert result["spec_itl_ms_p50"] <= result["baseline_itl_ms_p50"], (
+            result)
+        assert result["acceptance_rate"] and result["acceptance_rate"] > 0.5, (
+            result)
+        assert "serving_draft_tokens_total" in spec["exposition"]
+        assert "serving_accepted_tokens_total" in spec["exposition"]
+        assert "serving_accept_len" in spec["exposition"]
+        assert result["spec_steady_recompiles"] == {}, result
+        assert result["baseline_steady_recompiles"] == {}, result
+        assert result["flight_overhead_frac"] < 0.05, result
+    for k in ("exposition",):
+        spec.pop(k, None)
+    print(json.dumps(result), flush=True)
+    return result
+
+
 def bench_multichip(tp_list=(1, 2), V=1024, D=256, H=8, Hk=4, L=4,
                     slots=4, n_requests=16, prompt_len=16, max_new=32,
                     block_size=16, dtype="float32", smoke=False):
@@ -729,6 +970,19 @@ def main():
                     help="interference bench: pause (s) before each "
                          "closed-loop short refill — 0 saturates, > 0 "
                          "models paced traffic with idle headroom")
+    ap.add_argument("--speculative", action="store_true",
+                    help="speculative-decoding bench: draft-assisted "
+                         "verify ticks vs the plain mixed tick at high "
+                         "acceptance (flagship overfit on a periodic "
+                         "stream), decode tok/s + client-side ITL")
+    ap.add_argument("--draft", default="ngram",
+                    choices=["ngram", "model"],
+                    help="speculative bench drafter: self-speculative "
+                         "n-gram lookup (default) or a small overfit "
+                         "draft TransformerLM")
+    ap.add_argument("--spec-k", type=int, default=4,
+                    help="speculative bench: draft tokens proposed per "
+                         "row per tick (default 4)")
     ap.add_argument("--multichip", action="store_true",
                     help="tensor-parallel decode bench: the paged "
                          "engine under shard_map at each tp in "
@@ -745,6 +999,15 @@ def main():
             bench_multichip(tp_list=tp_list, smoke=args.smoke)
         else:
             run_multichip(tp_list=tp_list, smoke=args.smoke)
+        return
+    if args.speculative:
+        kw = dict(draft=args.draft, spec_k=args.spec_k,
+                  dtype=args.dtype, smoke=args.smoke)
+        if args.prefill_chunk is not None:
+            kw["prefill_chunk"] = args.prefill_chunk
+        if args.tick_token_budget is not None:
+            kw["tick_token_budget"] = args.tick_token_budget
+        bench_speculative(**kw)
         return
     if args.long_prompt_interference:
         kw = dict(slots=args.slots, dtype=args.dtype, smoke=args.smoke,

@@ -998,17 +998,18 @@ def generate(model, params, prompt, max_new_tokens: int,
     return out
 
 
-def sample_tokens(logits, rng, temperature=0.0, top_k=None, top_p=None):
-    """One sampling step: ``[B, vocab]`` logits → ``[B]`` int32 tokens.
-
-    Greedy argmax at temperature 0, else temperature softmax with
-    optional top-k / nucleus filtering. Module-level (factored out of
-    :func:`_generate_fn`) so the continuous-batching engine
-    (serving/engine.py) samples each slot with bit-identical math and
-    RNG usage to a solo :func:`generate` call — that identity is what
-    the slot-refill parity test asserts."""
-    if temperature == 0.0:
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+def filter_logits(logits, temperature, top_k=None, top_p=None):
+    """The sampling transform of :func:`sample_tokens` WITHOUT the draw:
+    ``[..., vocab]`` logits → temperature-scaled, top-k/top-p-masked
+    logits (``-inf`` outside the kept set). ``softmax(filter_logits(x))``
+    is therefore exactly the distribution ``sample_tokens`` draws from at
+    ``temperature > 0`` — the speculative-decoding verify tick
+    (serving/engine.py) needs those probabilities explicitly: the
+    accept ratio ``min(1, p/q)`` and the residual ``max(p - q, 0)`` of
+    rejection sampling must be computed on the *identical* filtered
+    distributions the solo sampler uses, or the accepted streams drift
+    from ``generate()``'s marginals. Requires ``temperature > 0``
+    (greedy has no distribution to filter; callers branch to argmax)."""
     logits = logits / temperature
     if top_k is not None or top_p is not None:
         # ONE descending sort serves both filters (this runs per
@@ -1032,7 +1033,23 @@ def sample_tokens(logits, rng, temperature=0.0, top_k=None, top_p=None):
             kept = jnp.where(beyond, jnp.inf, sorted_desc)
             thresh = jnp.min(kept, axis=-1, keepdims=True)
             logits = jnp.where(logits < thresh, -jnp.inf, logits)
-    return jax.random.categorical(rng, logits).astype(jnp.int32)
+    return logits
+
+
+def sample_tokens(logits, rng, temperature=0.0, top_k=None, top_p=None):
+    """One sampling step: ``[B, vocab]`` logits → ``[B]`` int32 tokens.
+
+    Greedy argmax at temperature 0, else temperature softmax with
+    optional top-k / nucleus filtering (:func:`filter_logits`).
+    Module-level (factored out of :func:`_generate_fn`) so the
+    continuous-batching engine (serving/engine.py) samples each slot
+    with bit-identical math and RNG usage to a solo :func:`generate`
+    call — that identity is what the slot-refill parity test asserts."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return jax.random.categorical(
+        rng, filter_logits(logits, temperature, top_k, top_p)
+    ).astype(jnp.int32)
 
 
 @functools.lru_cache(maxsize=32)

@@ -147,6 +147,30 @@ def serving_cache_specs(cache, tp_axis: str = "model"):
     return tree_map_with_path(spec, cache)
 
 
+def draft_param_specs(params, *, num_heads: int,
+                      num_kv_heads: Optional[int], tp_size: int,
+                      tp_axis: str = "model"):
+    """PartitionSpec tree for a speculative-decoding DRAFT model's params
+    under the serving mesh, plus the tensor-parallel degree the draft
+    module should be cloned with: ``(specs, draft_tp)``.
+
+    A draft is deliberately small — its KV-head count often does not
+    divide the serving mesh (a 2-head draft on a tp=4 mesh), and unlike
+    the flagship it is cheap enough that replication costs almost
+    nothing. So: when every head axis divides ``tp_size``, shard it
+    exactly like the flagship (:func:`lm_param_specs`, ``draft_tp =
+    tp_size``); otherwise return an all-replicated tree (``draft_tp =
+    1`` — each shard runs the whole draft redundantly and emits
+    identical proposals, which keeps the verify tick's draft-token
+    inputs replicated by construction)."""
+    hk = num_kv_heads or num_heads
+    if tp_size > 1 and num_heads % tp_size == 0 and hk % tp_size == 0:
+        return lm_param_specs(params, tp_axis=tp_axis), tp_size
+    from jax.tree_util import tree_map
+
+    return tree_map(lambda _: P(), params), 1
+
+
 def opt_state_specs(optimizer, params, param_specs):
     """PartitionSpec tree for ``optimizer.init(params)``: optimizer states
     embed param-shaped subtrees (mu/nu/trace/...), so each state leaf whose

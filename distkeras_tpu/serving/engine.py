@@ -80,7 +80,7 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from distkeras_tpu import telemetry
-from distkeras_tpu.models.transformer import sample_tokens
+from distkeras_tpu.models.transformer import filter_logits, sample_tokens
 from distkeras_tpu.telemetry.flight import FlightRecorder
 from distkeras_tpu.telemetry.runtime import MemoryWatermarks, recompiles
 from distkeras_tpu.telemetry.slo import StallWatchdog
@@ -372,6 +372,322 @@ def _paged_mixed_tick_fn(dm_paged, cfgs, chunk,
     return tick
 
 
+# -- speculative decoding (draft-assisted verify ticks) ----------------------
+#
+# A speculative tick generalizes the mixed tick's per-row roles into one
+# (n_forced, valid) pair per row: the row feeds `n_forced` tokens
+# unconditionally (its PENDING token — emitted last tick but not yet in
+# the cache — or a prompt chunk), plus `valid - n_forced` draft tokens
+# that must survive rejection sampling. With full = concat(last_logits,
+# window logits), window token j's target distribution is uniformly
+# full[:, j], so one accept rule covers every role:
+#
+#   idle row          n_forced=0 valid=0   nothing fed, nothing emitted
+#   prefill chunk     n_forced=C valid=C   no tests, no z (chunk tick)
+#   transition row    n_forced=0 valid=0   z ~ full[:,0]=last_logits —
+#                     the row's first decode token, emitted UNFED
+#   speculating row   n_forced=1 valid=1+w pending fed, w drafts tested
+#
+# Every sampling row emits its accepted drafts plus ONE extra token z ~
+# full[:, n_forced + accepted] (the rejection-sampling residual when a
+# draft was rejected, the bonus distribution when all survived), and z
+# is never fed — it becomes next tick's host-known pending token, which
+# is what lets the host (or the draft model) propose the next window
+# before the dispatch. Greedy rows accept a draft iff it IS the argmax,
+# so greedy streams are bit-identical to the non-speculative engine;
+# sampled rows are distributionally exact by the standard
+# rejection-sampling argument (Leviathan et al.). Rollback of rejected
+# suffixes is a cursor rewind only — rejected K/V bytes sit beyond the
+# rewound cursor, where the next tick's writes land before any query
+# can reach them (the same invariant _reset_slot_cursors relies on),
+# and verify windows never write outside the row's admitted region
+# (window width <= remaining tokens <= the preallocated block chain).
+
+
+def _rewind_cursors(cache, rewind):
+    """Subtract ``rewind`` [S] from every per-row cursor leaf (the [S]
+    int32 vectors: cache_index per layer, pos_index) — the rejected-
+    suffix rollback for the slot layout, and the draft cache's overshoot
+    undo. Runs inside the jitted bodies."""
+    return jax.tree.map(
+        lambda c: c - rewind if (c.ndim == 1 and c.dtype == jnp.int32)
+        else c, cache
+    )
+
+
+def _spec_accept(cfgs, k, onehot_q, full, rngs, valid, n_forced,
+                 sample_mask, draft_toks, q_probs):
+    """Rejection-sampling core shared by both verify ticks (traced).
+
+    ``full`` [S, W+1, V]: position j is the target's filtered-sampling
+    source for window token j (j=0 is the pre-window ``last_logits``).
+    Per row: accept the longest draft prefix where each draft d_i
+    survives ``u < min(1, p_i(d_i)/q_i(d_i))`` (greedy: ``d_i ==
+    argmax p_i``), then sample the extra token z from the residual
+    ``norm(max(p - q, 0))`` at the first rejection — or from the full
+    target distribution when every draft survived (the bonus token).
+    ``onehot_q`` marks a deterministic drafter (the n-gram fallback):
+    q is one-hot at the proposal, so the accept ratio is just p(d) and
+    the residual is p with the rejected token zeroed. The accept
+    draws and z ride ONE split of the row's RNG chain, advanced only
+    for rows that actually sampled (``sample_mask``) — prefill/idle
+    rows keep their chains untouched.
+
+    Returns ``(out_toks [S, k+1], acc [S], new_last [S, V],
+    new_rngs)``: out_toks rows are [accepted drafts..., z, 0 pad];
+    new_last is uniformly ``full[s, n_forced + acc]`` — for prefill
+    rows (acc 0, n_forced = valid) that is exactly the
+    logits-at-last-valid-token rule of the mixed tick."""
+    V = full.shape[-1]
+    out_toks, accs, new_last, new_rngs = [], [], [], []
+    pos = jnp.arange(k + 1)
+    for s, (temp, top_k, top_p) in enumerate(cfgs):
+        n_draft = valid[s] - n_forced[s]
+        j = n_forced[s] + jnp.arange(k)  # window position of draft i
+        pd = jnp.take(full[s], j, axis=0)  # [k, V] (OOB clipped, masked)
+        d = draft_toks[s]
+        rng, sub = jax.random.split(rngs[s])
+        u_key, z_key = jax.random.split(sub)
+        if temp == 0.0:
+            ok = d == jnp.argmax(pd, axis=-1).astype(jnp.int32)
+        else:
+            p_prob = jax.nn.softmax(
+                filter_logits(pd, temp, top_k, top_p), axis=-1)
+            p_at_d = jnp.take_along_axis(p_prob, d[:, None], axis=-1)[:, 0]
+            if onehot_q:
+                ratio = p_at_d
+            else:
+                q_at_d = jnp.take_along_axis(
+                    q_probs[s], d[:, None], axis=-1)[:, 0]
+                ratio = p_at_d / jnp.maximum(q_at_d, 1e-30)
+            u = jax.random.uniform(u_key, (k,))
+            ok = u < jnp.minimum(ratio, 1.0)
+        ok = ok & (jnp.arange(k) < n_draft)
+        acc = jnp.sum(jnp.cumprod(ok.astype(jnp.int32)))
+        z_logits = jnp.take(full[s], n_forced[s] + acc, axis=0)
+        if temp == 0.0:
+            z = jnp.argmax(z_logits).astype(jnp.int32)
+        else:
+            p_z = jax.nn.softmax(filter_logits(z_logits, temp,
+                                               top_k, top_p))
+            a_clip = jnp.minimum(acc, k - 1)  # the first-rejected draft
+            if onehot_q:
+                q_z = jax.nn.one_hot(jnp.take(d, a_clip), V,
+                                     dtype=p_z.dtype)
+            else:
+                q_z = jnp.take(q_probs[s], a_clip, axis=0)
+            resid = jnp.maximum(p_z - q_z, 0.0)
+            dist = jnp.where(acc >= n_draft, p_z, resid)
+            tot = jnp.sum(dist)
+            # p == q exactly makes the residual vanish; rejection then
+            # had probability 0, so the fallback is never drawn — it
+            # only keeps the categorical finite
+            dist = jnp.where(tot > 0, dist / jnp.maximum(tot, 1e-30),
+                             p_z)
+            z = jax.random.categorical(
+                z_key, jnp.log(jnp.maximum(dist, 1e-38))
+            ).astype(jnp.int32)
+        dp = jnp.concatenate([d, jnp.zeros((1,), jnp.int32)])
+        out_toks.append(
+            jnp.where(pos < acc, dp, jnp.where(pos == acc, z, 0)))
+        accs.append(acc)
+        new_last.append(z_logits)
+        new_rngs.append(jnp.where(sample_mask[s], rng, rngs[s]))
+    return (jnp.stack(out_toks), jnp.stack(accs),
+            jnp.stack(new_last), jnp.stack(new_rngs))
+
+
+def _merge_drafts(fed, valid, n_forced, draft_toks, k):
+    """Scatter each row's draft tokens into its window columns
+    ``n_forced .. valid-1`` (device-side: a model drafter's proposals
+    never round-trip the host). Forced columns and prefill chunks stay
+    as the host built them."""
+    cols = jnp.arange(fed.shape[1])[None, :]
+    di = cols - n_forced[:, None]
+    return jnp.where(
+        (di >= 0) & (cols < valid[:, None]),
+        jnp.take_along_axis(draft_toks, jnp.clip(di, 0, k - 1), axis=1),
+        fed,
+    )
+
+
+@functools.lru_cache(maxsize=256)
+def _spec_verify_fn(dm_slot, cfgs, W, k, onehot_q,
+                    ctx: Optional[_ShardCtx] = None):
+    """Compiled speculative verify tick, slot layout: ONE ``[S, W]``
+    dispatch writes every row's window K/V at its absolute positions
+    (the chunked mixed tick's valid_lens machinery verbatim), scores
+    all window positions, runs per-row rejection sampling
+    (:func:`_spec_accept`), and rewinds the [S] cache cursors past the
+    rejected suffixes in the same dispatch — acceptance-length
+    variation changes only traced values, never shapes, so steady
+    state stays at zero recompiles. Under a mesh ``ctx`` the body runs
+    per head-shard with sampling on replicated logits, like every
+    other tick."""
+
+    @functools.partial(_compile, ctx=ctx, in_kinds="pcrrrrrrrr",
+                       out_kinds="crrrr", donate=(1, 2, 3))
+    def tick(params_only, cache, last_logits, rngs, fed, valid,
+             n_forced, sample_mask, draft_toks, q_probs):
+        recompiles.note("serve.spec_tick")
+        merged = _merge_drafts(fed, valid, n_forced, draft_toks, k)
+        logits, vs = dm_slot.apply(
+            {**params_only, "cache": cache}, merged,
+            valid_lens=valid, mutable=["cache"],
+        )
+        full = jnp.concatenate(
+            [last_logits[:, None], logits.astype(jnp.float32)], axis=1)
+        out_toks, acc, new_last, new_rngs = _spec_accept(
+            cfgs, k, onehot_q, full, rngs, valid, n_forced,
+            sample_mask, draft_toks, q_probs)
+        new_cache = _rewind_cursors(vs["cache"],
+                                    valid - (n_forced + acc))
+        return new_cache, new_last, out_toks, acc, new_rngs
+
+    return tick
+
+
+@functools.lru_cache(maxsize=256)
+def _paged_spec_verify_fn(dm_paged, cfgs, W, k, onehot_q,
+                          ctx: Optional[_ShardCtx] = None):
+    """Paged twin of :func:`_spec_verify_fn`: window K/V routed through
+    each row's block table. No in-dispatch rollback — the paged
+    cursors (``seq_lens``) are host-owned, so the engine simply
+    advances each row by ``n_forced + acc`` instead of ``valid``;
+    rejected-draft bytes sit in row-private blocks beyond the cursor
+    (windows never reach shared prefix blocks: those end before the
+    row's write region by the COW-at-admission invariant, and never
+    past the chain: window width <= remaining <= the preallocated
+    worst case — so rollback touches no block refcounts at all)."""
+
+    @functools.partial(_compile, ctx=ctx, in_kinds="pcrrrrrrrrrr",
+                       out_kinds="crrrr", donate=(1, 2, 3))
+    def tick(params_only, cache, last_logits, rngs, tables, lens, fed,
+             valid, n_forced, sample_mask, draft_toks, q_probs):
+        recompiles.note("serve.paged_spec_tick")
+        merged = _merge_drafts(fed, valid, n_forced, draft_toks, k)
+        logits, vs = dm_paged.apply(
+            {**params_only, "cache": cache}, merged,
+            block_tables=tables, seq_lens=lens, valid_lens=valid,
+            mutable=["cache"],
+        )
+        full = jnp.concatenate(
+            [last_logits[:, None], logits.astype(jnp.float32)], axis=1)
+        out_toks, acc, new_last, new_rngs = _spec_accept(
+            cfgs, k, onehot_q, full, rngs, valid, n_forced,
+            sample_mask, draft_toks, q_probs)
+        return vs["cache"], new_last, out_toks, acc, new_rngs
+
+    return tick
+
+
+@functools.lru_cache(maxsize=64)
+def _draft_feed_fn(dm_draft, ctx: Optional[_ShardCtx] = None):
+    """Compiled draft-cache catch-up feed: one ``[S, Wd]`` valid_lens
+    dispatch that (1) rewinds each row's draft cursors past last
+    tick's rejected proposals, then (2) feeds each row's queue of true
+    tokens the draft hasn't consumed yet — prompt chunks during
+    prefill, the 1-2 tokens emitted-since-last-draft in steady state —
+    and returns the logits at each row's last valid token (the
+    distribution the first proposal samples from)."""
+
+    @functools.partial(_compile, ctx=ctx, in_kinds="pcrrr",
+                       out_kinds="cr", donate=(1,))
+    def feed(draft_params, cache, fed, valid, rewind):
+        recompiles.note("serve.draft_feed")
+        cache = _rewind_cursors(cache, rewind)
+        logits, vs = dm_draft.apply(
+            {**draft_params, "cache": cache}, fed,
+            valid_lens=valid, mutable=["cache"],
+        )
+        last = jnp.take_along_axis(
+            logits, jnp.maximum(valid - 1, 0)[:, None, None], axis=1
+        )[:, 0]
+        return vs["cache"], last.astype(jnp.float32)
+
+    return feed
+
+
+@functools.lru_cache(maxsize=256)
+def _draft_step_fn(dm_draft, cfgs, ctx: Optional[_ShardCtx] = None):
+    """Compiled draft proposal step: sample one proposal per row from
+    the incoming draft logits (each row's own sampling config — the
+    proposal distribution q must be the draft's *filtered* softmax,
+    because that q enters the verify tick's accept ratio), feed the
+    proposals back into the draft cache (``feed_valid`` 0 on the last
+    step: the k-th proposal is never fed), and return the next logits
+    plus the proposal tokens and their full q distributions. Draft
+    RNG chains are separate from the engine's emission chains and
+    advance only for speculating rows."""
+
+    @functools.partial(_compile, ctx=ctx, in_kinds="pcrrrr",
+                       out_kinds="crrrr", donate=(1, 2, 3))
+    def step(draft_params, cache, logits_in, rngs, feed_valid,
+             spec_mask):
+        recompiles.note("serve.draft_step")
+        V = logits_in.shape[-1]
+        toks, qs, new_rngs = [], [], []
+        for s, (temp, top_k, top_p) in enumerate(cfgs):
+            if temp == 0.0:
+                tok = jnp.argmax(logits_in[s]).astype(jnp.int32)
+                # greedy q is a formality (the verify tick's greedy
+                # branch never reads it); the chain stays untouched
+                qs.append(jax.nn.one_hot(tok, V, dtype=jnp.float32))
+                new_rngs.append(rngs[s])
+            else:
+                rng, sub = jax.random.split(rngs[s])
+                f = filter_logits(logits_in[s], temp, top_k, top_p)
+                tok = jax.random.categorical(sub, f).astype(jnp.int32)
+                qs.append(jax.nn.softmax(f))
+                new_rngs.append(jnp.where(spec_mask[s], rng, rngs[s]))
+            toks.append(tok)
+        tok = jnp.stack(toks)
+        logits, vs = dm_draft.apply(
+            {**draft_params, "cache": cache}, tok[:, None],
+            valid_lens=feed_valid, mutable=["cache"],
+        )
+        return (vs["cache"], logits[:, 0].astype(jnp.float32), tok,
+                jnp.stack(qs), jnp.stack(new_rngs))
+
+    return step
+
+
+def _ngram_propose(history: np.ndarray, k: int, max_n: int = 3):
+    """Self-speculative n-gram drafter (host-side, no second model):
+    match the stream's suffix n-gram (n from ``max_n`` down to 1)
+    against its most recent earlier occurrence in ``history`` (prompt +
+    emitted tokens) and propose the k tokens that followed it. Overlap
+    with the suffix itself is allowed — a stream stuck on one token
+    matches at distance 1 and proposes the repeat, the common case
+    that makes greedy loops nearly free. Returns ``(proposal [k]
+    int32, found)``; found 0 means no match (the row decodes plain
+    this tick)."""
+    L = int(history.size)
+    for n in range(min(max_n, L - 1), 0, -1):
+        # candidate starts 0 .. L-n-1: strictly before the suffix, with
+        # at least one continuation token inside history
+        hay = history[:L - 1]
+        if hay.size < n:
+            continue
+        windows = np.lib.stride_tricks.sliding_window_view(hay, n)
+        hits = np.nonzero((windows == history[L - n:]).all(axis=1))[0]
+        if hits.size:
+            start = int(hits[-1])
+            # continuation read from the stream EXTENDED BY THE PROPOSAL
+            # itself: once the read index crosses the end of history it
+            # lands on an already-proposed token, i.e. the periodic
+            # extension of the matched cycle — a repeat-token stream
+            # (distance-1 match) proposes k repeats, not one
+            ext = history.tolist()
+            out = np.empty(k, np.int32)
+            for i in range(k):
+                t = int(ext[start + n + i])
+                out[i] = t
+                ext.append(t)
+            return out, k
+    return np.zeros(k, np.int32), 0
+
+
 @functools.partial(jax.jit, donate_argnums=(0,))
 def _reset_slot_cursors(cache, slot):
     """Park slot ``slot`` at depth 0 for its next tenant: the [S]
@@ -440,6 +756,16 @@ class _SlotState:
     decoding: bool = True
     admit_seq: int = 0  # admission order: prefill budget is dealt FIFO
     admit_t: float = 0.0  # monotonic admission time (prefill span)
+    # speculative decoding (engine.spec): the row's emitted-but-unfed
+    # token (None until the transition tick samples the first one), the
+    # prompt+emitted history the n-gram drafter matches against, the
+    # queue of true tokens the draft model hasn't consumed yet, and the
+    # draft-cursor overshoot (rejected proposals) to rewind at its next
+    # feed
+    pending_tok: Optional[int] = None
+    history: Optional[np.ndarray] = None
+    draft_queue: Optional[np.ndarray] = None
+    draft_rewind: int = 0
 
 
 class ServingEngine:
@@ -522,6 +848,29 @@ class ServingEngine:
         where the shape tiles on this backend, else the gathered
         reference), 'pallas' (force; interpret mode off-TPU), 'gather'
         (force the reference). Paged mode only.
+      draft: enable speculative decoding (chunked mode only). Either a
+        small TRAINING-mode :class:`TransformerLM` (same vocab; pass
+        its variables as ``draft_params``) that proposes ``spec_k``
+        tokens per decoding row per tick with its own slot-cursor
+        cache, or ``"ngram"`` — the self-speculative fallback that
+        needs no second model: proposals come from matching the
+        stream's suffix n-gram against its own prompt + emitted
+        history. The flagship verifies every window in ONE fused
+        ``[S, k+1]`` dispatch (the mixed tick's ``valid_lens``
+        machinery) and accepts a per-row prefix by rejection sampling:
+        greedy streams stay bit-identical to the non-speculative
+        engine, sampled streams are distributionally exact. Verify
+        tokens are charged against the scheduler's
+        ``tick_token_budget`` (decodes reserve 1 each, prompt chunks
+        are dealt next, leftover widens the windows), so chunked
+        prefill and speculation coexist. Rejected suffixes roll back
+        as cursor rewinds on both cache layouts; acceptance-length
+        variation never changes a compiled shape (fixed ``spec_k``
+        padding — zero steady-state recompiles).
+      draft_params: the draft model's trained variables.
+      spec_k: draft tokens proposed per row per tick (default 4).
+      ngram_max: longest suffix n-gram the ``"ngram"`` drafter matches
+        (default 3).
 
     Drive it with :meth:`step` (one admit→tick→complete→refill cycle,
     e.g. from a test) or :meth:`serve_forever` (the TCP front-end's
@@ -542,7 +891,9 @@ class ServingEngine:
                  flight=True, flight_capacity: int = 512,
                  postmortem_dir: str = "/tmp",
                  mesh=None, tp_axis: str = "model",
-                 paged_kernel: str = "auto"):
+                 paged_kernel: str = "auto",
+                 draft=None, draft_params=None, spec_k: int = 4,
+                 ngram_max: int = 3):
         if slots < 1:
             raise ValueError(f"slots must be >= 1; got {slots}")
         if prefill_chunk is not None and prefill_chunk < 1:
@@ -552,6 +903,49 @@ class ServingEngine:
             )
         self.prefill_chunk = prefill_chunk
         self._admit_seq = 0
+        # speculative decoding: a drafter proposes up to spec_k tokens
+        # per decoding row per tick; the flagship verifies them in one
+        # fused window and accepts a prefix by rejection sampling
+        self.spec = draft is not None
+        self.spec_k = spec_k
+        self.ngram_max = ngram_max
+        if self.spec:
+            if prefill_chunk is None:
+                raise ValueError(
+                    "speculative decoding rides the fused mixed tick — "
+                    "it needs chunked prefill (prefill_chunk is not "
+                    "None)"
+                )
+            if spec_k < 1:
+                raise ValueError(f"spec_k must be >= 1; got {spec_k}")
+            if isinstance(draft, str):
+                if draft != "ngram":
+                    raise ValueError(
+                        f"Unknown draft '{draft}'. Known: 'ngram' "
+                        f"(self-speculative n-gram lookup), or a small "
+                        f"TransformerLM plus draft_params"
+                    )
+                if draft_params is not None:
+                    raise ValueError(
+                        "draft='ngram' takes no draft_params (it "
+                        "proposes from the stream's own history)"
+                    )
+                self.draft_kind = "ngram"
+            else:
+                if draft_params is None:
+                    raise ValueError(
+                        "a draft model needs its trained variables: "
+                        "pass draft_params"
+                    )
+                if draft.vocab_size != model.vocab_size:
+                    raise ValueError(
+                        f"draft vocab_size={draft.vocab_size} != model "
+                        f"vocab_size={model.vocab_size}: proposals must "
+                        f"live in the flagship's token space"
+                    )
+                self.draft_kind = "model"
+        else:
+            self.draft_kind = None
         # tensor-parallel serving: a 1-D mesh shards the jitted tick
         # bodies (weights + cache) over tp_axis; everything host-side
         # stays single-process
@@ -688,6 +1082,44 @@ class ServingEngine:
                     jnp.zeros((slots, 1), jnp.int32),
                 )["cache"],
             )
+        self._dm_draft = None
+        self._draft_ctx: Optional[_ShardCtx] = None
+        if self.draft_kind == "model":
+            # the draft's slot cache mirrors the target's per-row
+            # positions exactly (same max_len, same slot count), so its
+            # proposals condition on the identical token history; under
+            # a mesh it shards like the flagship when its head counts
+            # divide, else replicates (draft_param_specs decides)
+            draft_tp = 1
+            if mesh is not None:
+                from distkeras_tpu.parallel.spmd import draft_param_specs
+
+                _, draft_tp = draft_param_specs(
+                    {"params": draft_params["params"]},
+                    num_heads=draft.num_heads,
+                    num_kv_heads=draft.num_kv_heads,
+                    tp_size=self.tp, tp_axis=tp_axis,
+                )
+            self.draft_model = draft.clone(max_len=self.model.max_len,
+                                           parent=None)
+            draft_kw = ({"tp_size": draft_tp, "tp_axis": tp_axis}
+                        if draft_tp > 1 else {})
+            self._dm_draft = self.draft_model.clone(
+                decode=True, slot_cursor=True, parent=None, **draft_kw
+            )
+            dm_tpl = (self._dm_draft if draft_tp == 1
+                      else self.draft_model.clone(
+                          decode=True, slot_cursor=True, parent=None))
+            self._draft_params_only = {"params": draft_params["params"]}
+            self._draft_cache = jax.tree.map(
+                lambda s: jnp.zeros(s.shape, s.dtype),
+                jax.eval_shape(
+                    dm_tpl.init, jax.random.PRNGKey(0),
+                    jnp.zeros((slots, 1), jnp.int32),
+                )["cache"],
+            )
+            self._draft_tp = draft_tp
+        self._draft_rngs = jnp.zeros((slots, 2), jnp.uint32)
         self._last_logits = jnp.zeros(
             (slots, self.model.vocab_size), jnp.float32
         )
@@ -704,6 +1136,10 @@ class ServingEngine:
         self.prompt_tokens = 0
         self.prefix_hit_tokens = 0
         self._occ_sum = 0
+        # speculative decoding accounting (per-engine; the registry
+        # counters are the process-cumulative twins)
+        self.draft_tokens_proposed = 0
+        self.draft_tokens_accepted = 0
 
     def _init_mesh_ctx(self):
         """Shard the device-side engine state onto the mesh and build
@@ -762,6 +1198,32 @@ class ServingEngine:
             cspec=_freeze(cspec, is_leaf=is_p),
             cache1=cache1,
         )
+        if self._dm_draft is not None:
+            from distkeras_tpu.parallel.spmd import draft_param_specs
+
+            dpspec, dtp = draft_param_specs(
+                self._draft_params_only,
+                num_heads=self.draft_model.num_heads,
+                num_kv_heads=self.draft_model.num_kv_heads,
+                tp_size=self.tp, tp_axis=axis,
+            )
+            # sharded draft: cache KV-head axis sliced like the
+            # flagship's; replicated draft: every leaf P() — each shard
+            # runs the whole drafter and proposes identical tokens
+            dcspec = (serving_cache_specs(self._draft_cache,
+                                          tp_axis=axis)
+                      if dtp > 1 else
+                      jax.tree.map(lambda _: P(), self._draft_cache))
+            self._draft_params_only = jax.device_put(
+                self._draft_params_only, named(dpspec))
+            self._draft_cache = jax.device_put(self._draft_cache,
+                                               named(dcspec))
+            self._draft_rngs = jax.device_put(self._draft_rngs, rep)
+            self._draft_ctx = _ShardCtx(
+                mesh=mesh, axis=axis,
+                pspec=_freeze(dpspec, is_leaf=is_p),
+                cspec=_freeze(dcspec, is_leaf=is_p),
+            )
 
     def _wire_metrics(self):
         """Register this engine's metric handles (get-or-create: many
@@ -828,6 +1290,19 @@ class ServingEngine:
         self._m_crashes = reg.counter(
             "serving_engine_crashes_total",
             "exceptions escaping step() (each dumps a flight postmortem)")
+        # speculative decoding (PR 7): proposals entering verify
+        # windows, survivors of rejection sampling, and the per-row
+        # accepted-prefix-length distribution
+        self._m_draft_tokens = reg.counter(
+            "serving_draft_tokens_total",
+            "speculative draft tokens entering verify windows")
+        self._m_accepted_tokens = reg.counter(
+            "serving_accepted_tokens_total",
+            "draft tokens accepted by rejection sampling")
+        self._m_accept_len = reg.histogram(
+            "serving_accept_len",
+            "accepted draft prefix length per speculating row per tick",
+            buckets=(0, 1, 2, 3, 4, 6, 8, 12, 16))
 
     # -- submission ---------------------------------------------------------
 
@@ -903,7 +1378,9 @@ class ServingEngine:
         n_prefills = self._admit()
         occupied = any(st is not None for st in self._slots)
         if occupied:
-            if self.prefill_chunk is not None:
+            if self.spec:
+                self._spec_tick()
+            elif self.prefill_chunk is not None:
                 self._mixed_tick()
             else:
                 self._decode_tick()
@@ -1175,12 +1652,26 @@ class ServingEngine:
             self._cache = _reset_slot_cursors(self._cache,
                                               jnp.int32(slot))
         self._rngs = self._rngs.at[slot].set(jax.random.PRNGKey(req.seed))
-        self._slots[slot] = _SlotState(
+        st = _SlotState(
             req=req, remaining=req.max_new_tokens, blocks=chain,
             cached_tokens=cached,
             pending=np.asarray(req.prompt[cached:], np.int32),
             decoding=False, admit_seq=self._admit_seq, admit_t=now,
         )
+        if self.spec:
+            # speculative state: the drafter conditions on the FULL
+            # prompt (a radix prefix hit skips target prefill for the
+            # shared span, but neither the n-gram history nor the
+            # draft model's private cache has seen it)
+            if self.draft_kind == "ngram":
+                st.history = np.asarray(req.prompt, np.int32).copy()
+            else:
+                st.draft_queue = np.asarray(req.prompt, np.int32).copy()
+                self._draft_cache = _reset_slot_cursors(
+                    self._draft_cache, jnp.int32(slot))
+                self._draft_rngs = self._draft_rngs.at[slot].set(
+                    jax.random.fold_in(jax.random.PRNGKey(req.seed), 1))
+        self._slots[slot] = st
         self._admit_seq += 1
         self.prompt_tokens += Tp
         self._m_prompt_tokens.inc(Tp)
@@ -1326,6 +1817,290 @@ class ServingEngine:
             n_dec=n_dec, prefill_tokens=fed_tokens, chunk=C,
             emitted=emitted, occupancy=occupancy,
             queue_depth=queue_depth,
+        )
+
+    # -- speculative decoding (draft-assisted verify ticks) ------------------
+
+    def _run_draft(self, cfgs, spec_rows):
+        """Draft-model pass for one speculative tick: ONE catch-up feed
+        (each row's queue of true tokens the draft hasn't consumed —
+        prompt chunks after admission, the 1-2 tokens emitted since
+        the last window in steady state — with any rejected-proposal
+        cursor overshoot rewound in the same dispatch), then ``spec_k``
+        proposal steps, each sampling one draft token per speculating
+        row and feeding it (the k-th is sample-only). Returns
+        ``(q_probs [S, k, V], draft_toks [S, k])`` on device — the
+        proposals never round-trip the host."""
+        S, k = self.slots, self.spec_k
+        feed_rows = [
+            (s, st) for s, st in enumerate(self._slots)
+            if st is not None and st.draft_queue is not None
+            and (st.draft_queue.size > 0 or st.draft_rewind > 0)
+        ]
+        # full-shape dummies: a no-proposal tick still traces the
+        # verify fn's q lookups for sampled rows (masked to no effect
+        # by their zero draft counts)
+        none_q = jnp.zeros((S, k, self.model.vocab_size), jnp.float32)
+        none_d = jnp.zeros((S, k), jnp.int32)
+        if not feed_rows and not spec_rows:
+            return none_q, none_d
+        # steady state feeds at most 2 lag tokens per row; only prompt
+        # catch-up widens the feed to chunk size (two compiled shapes)
+        need = max((int(st.draft_queue.size) for _, st in feed_rows),
+                   default=0)
+        Wd = 2 if need <= 2 else max(self.prefill_chunk, 2)
+        dfed = np.zeros((S, Wd), np.int32)
+        dvalid = np.zeros((S,), np.int32)
+        rewind = np.zeros((S,), np.int32)
+        for s, st in feed_rows:
+            take = min(Wd, int(st.draft_queue.size))
+            dfed[s, :take] = st.draft_queue[:take]
+            dvalid[s] = take
+            rewind[s] = st.draft_rewind
+            st.draft_queue = st.draft_queue[take:]
+            st.draft_rewind = 0
+        feed = _draft_feed_fn(self._dm_draft, self._draft_ctx)
+        self._draft_cache, logits = feed(
+            self._draft_params_only, self._draft_cache,
+            jnp.asarray(dfed), jnp.asarray(dvalid), jnp.asarray(rewind))
+        if not spec_rows:
+            return none_q, none_d
+        spec_mask = np.zeros((S,), bool)
+        for s, _ in spec_rows:
+            spec_mask[s] = True
+        step = _draft_step_fn(self._dm_draft, cfgs, self._draft_ctx)
+        sm = jnp.asarray(spec_mask)
+        feed_on = jnp.asarray(spec_mask.astype(np.int32))
+        feed_off = jnp.zeros((S,), jnp.int32)
+        toks_l, qs_l = [], []
+        for i in range(k):
+            (self._draft_cache, logits, tok, q,
+             self._draft_rngs) = step(
+                self._draft_params_only, self._draft_cache, logits,
+                self._draft_rngs,
+                feed_on if i < k - 1 else feed_off, sm)
+            toks_l.append(tok)
+            qs_l.append(q)
+        return jnp.stack(qs_l, axis=1), jnp.stack(toks_l, axis=1)
+
+    def _spec_tick(self):
+        """One speculative mixed tick: plan per-row verify windows
+        (pending token + granted draft width) and prompt chunks under
+        the shared token budget, run the drafter (model steps or
+        host-side n-gram lookup), verify everything in ONE fused
+        ``[S, W]`` dispatch with per-row rejection sampling and
+        in-dispatch rollback, then emit each row's accepted prefix
+        plus its extra token. Acceptance-length variation changes only
+        traced values — steady state compiles exactly two shapes
+        (``[S, k+1]`` all-decode, ``[S, max(C, k+1)]`` with chunks),
+        like the non-speculative mixed tick."""
+        t_plan0 = time.perf_counter()
+        S, k = self.slots, self.spec_k
+        cfgs = tuple(
+            (st.req.temperature, st.req.top_k, st.req.top_p)
+            if st else _IDLE_CFG
+            for st in self._slots
+        )
+        pre = sorted(
+            ((s, st) for s, st in enumerate(self._slots)
+             if st and not st.decoding),
+            key=lambda p: p[1].admit_seq,
+        )
+        dec = [(s, st) for s, st in enumerate(self._slots)
+               if st and st.decoding]
+        # rows eligible to speculate: a host-known pending token, room
+        # for at least one draft, and a drafter able to propose (the
+        # n-gram index found a match / the draft model is caught up)
+        spec_rows, want = [], []
+        ngram_toks = {}
+        for s, st in dec:
+            if st.pending_tok is None:
+                continue  # transition row: samples its first token
+            w = min(k, st.remaining - 1)
+            if self.draft_kind == "ngram":
+                toks, found = _ngram_propose(st.history, k,
+                                             self.ngram_max)
+                ngram_toks[s] = toks
+                w = min(w, found)
+            elif st.draft_queue is not None and st.draft_queue.size > 2:
+                w = 0  # draft still consuming the prompt
+            if w > 0:
+                spec_rows.append((s, st))
+                want.append(w)
+        spec_set = {s for s, _ in spec_rows}
+        takes, widths = self.scheduler.plan_spec(
+            len(dec), [len(st.pending) for _, st in pre],
+            self.prefill_chunk, want,
+        )
+        fed_tokens = sum(takes)
+        W = max(self.prefill_chunk, k + 1) if fed_tokens else k + 1
+        fed = np.zeros((S, W), np.int32)
+        valid = np.zeros((S,), np.int32)
+        n_forced = np.zeros((S,), np.int32)
+        sample_mask = np.zeros((S,), bool)
+        draft_np = np.zeros((S, k), np.int32)
+        granted = np.zeros((S,), np.int32)
+        for s, st in dec:
+            sample_mask[s] = True
+            if st.pending_tok is not None:
+                fed[s, 0] = st.pending_tok
+                n_forced[s] = 1
+                valid[s] = 1
+        for (s, st), w in zip(spec_rows, widths):
+            valid[s] = 1 + w
+            granted[s] = w
+            if self.draft_kind == "ngram":
+                draft_np[s] = ngram_toks[s]
+        for (s, st), take in zip(pre, takes):
+            if take > 0:
+                fed[s, :take] = st.pending[:take]
+                valid[s] = take
+                n_forced[s] = take
+        t0 = time.perf_counter()
+        plan_ms = (t0 - t_plan0) * 1e3
+        if self.draft_kind == "model":
+            q_probs, draft_dev = self._run_draft(cfgs, spec_rows)
+        else:
+            q_probs = jnp.zeros((1,), jnp.float32)
+            draft_dev = jnp.asarray(draft_np)
+        onehot = self.draft_kind == "ngram"
+        if self.paged:
+            tick = _paged_spec_verify_fn(self._dm_paged, cfgs, W, k,
+                                         onehot, self._ctx)
+            (self._cache, self._last_logits, toks, acc,
+             self._rngs) = tick(
+                self._params_only, self._cache, self._last_logits,
+                self._rngs, jnp.asarray(self._block_tables),
+                jnp.asarray(self._seq_lens), jnp.asarray(fed),
+                jnp.asarray(valid), jnp.asarray(n_forced),
+                jnp.asarray(sample_mask), draft_dev, q_probs,
+            )
+        else:
+            tick = _spec_verify_fn(self._dm_slot, cfgs, W, k, onehot,
+                                   self._ctx)
+            (self._cache, self._last_logits, toks, acc,
+             self._rngs) = tick(
+                self._params_only, self._cache, self._last_logits,
+                self._rngs, jnp.asarray(fed), jnp.asarray(valid),
+                jnp.asarray(n_forced), jnp.asarray(sample_mask),
+                draft_dev, q_probs,
+            )
+        toks_host = np.asarray(toks)  # forces completion of the tick
+        acc_host = np.asarray(acc)
+        if self.paged:
+            # REBIND, never mutate (aliasing hazard, see _decode_tick):
+            # each row keeps only its forced tokens plus the accepted
+            # prefix — the rejected-suffix rollback IS this arithmetic
+            self._seq_lens = self._seq_lens + (
+                n_forced + acc_host).astype(np.int32)
+        tick_ms = (time.perf_counter() - t0) * 1e3
+        t_stream0 = time.perf_counter()
+        self.ticks += 1
+        occupancy = sum(st is not None for st in self._slots)
+        self._occ_sum += occupancy
+        now = time.monotonic()
+        emitted = 0
+        proposed = int(granted.sum())
+        accepted = 0
+        for s, st in enumerate(self._slots):
+            if st is None:
+                continue
+            req = st.req
+            if not st.decoding:
+                take = int(valid[s])
+                if take > 0:
+                    st.pending = st.pending[take:]
+                    if st.pending.size == 0:
+                        # last chunk landed: next tick is this row's
+                        # transition tick (samples its first token,
+                        # which becomes the pending token)
+                        st.decoding = True
+                        req.prefill_done_t = now
+                        prefill_ms = (now - st.admit_t) * 1e3
+                        self.tracer.record(
+                            req.trace_id, "prefill", st.admit_t,
+                            prefill_ms, slot=s,
+                            prompt_tokens=int(req.prompt.size),
+                            cached_tokens=st.cached_tokens,
+                            chunk=self.prefill_chunk,
+                        )
+                        self._m_prefill_ms.observe(prefill_ms)
+                continue
+            a = int(acc_host[s])
+            if granted[s] > 0:
+                accepted += a
+                self._m_accept_len.observe(a)
+            toks_row = [int(t) for t in toks_host[s, :a + 1]]
+            done = False
+            for tok in toks_row:
+                if req.first_token_t is None:
+                    req.first_token_t = now
+                    self._m_ttft_ms.observe((now - req.submit_t) * 1e3)
+                else:
+                    self._m_itl_ms.observe(
+                        (now - req.last_token_t) * 1e3)
+                req.last_token_t = now
+                req.stream._put(tok)
+                req.n_emitted += 1
+                st.remaining -= 1
+                self.tokens_generated += 1
+                emitted += 1
+                if req.eos_id is not None and tok == req.eos_id:
+                    self._complete(s, "eos")
+                    done = True
+                    break
+                if st.remaining == 0:
+                    self._complete(s, "length")
+                    done = True
+                    break
+            if done:
+                continue
+            st.pending_tok = toks_row[-1]
+            if st.history is not None:
+                st.history = np.concatenate(
+                    [st.history, np.asarray(toks_row, np.int32)])
+            if self.draft_kind == "model":
+                lag = []
+                if s in spec_set and a == k:
+                    # every proposal survived: the k-th was accepted
+                    # but never fed to the draft (only d_1..d_{k-1}
+                    # were) — it precedes the extra token in the queue
+                    lag.append(int(toks_host[s, k - 1]))
+                lag.append(st.pending_tok)
+                lag_np = np.asarray(lag, np.int32)
+                st.draft_queue = (
+                    np.concatenate([st.draft_queue, lag_np])
+                    if st.draft_queue.size else lag_np)
+                if s in spec_set:
+                    st.draft_rewind = max(k - 1 - a, 0)
+        self.draft_tokens_proposed += proposed
+        self.draft_tokens_accepted += accepted
+        self._m_draft_tokens.inc(proposed)
+        self._m_accepted_tokens.inc(accepted)
+        queue_depth = self.scheduler.depth()
+        self._m_ticks.inc()
+        self._m_tokens.inc(emitted)
+        self._m_occupancy.set(sum(st is not None for st in self._slots))
+        self._m_tick_ms.observe(tick_ms)
+        if fed_tokens + len(dec) > 0:
+            self._m_prefill_frac.observe(
+                fed_tokens / (fed_tokens + len(dec)))
+        if tick_ms > 0:
+            self._m_decode_tps.set(round(emitted / (tick_ms / 1e3), 3))
+        self.metrics.log(
+            step=self.ticks, occupancy=occupancy,
+            queue_depth=queue_depth,
+            token_ms=round(tick_ms, 3),
+            prefill_tokens=fed_tokens,
+            draft_tokens=proposed, accepted_tokens=accepted,
+        )
+        self._record_tick(
+            plan_ms=plan_ms, device_ms=tick_ms,
+            stream_ms=(time.perf_counter() - t_stream0) * 1e3,
+            n_dec=len(dec), prefill_tokens=fed_tokens, chunk=W,
+            emitted=emitted, occupancy=occupancy,
+            queue_depth=queue_depth,
+            draft_tokens=proposed, accepted_tokens=accepted,
         )
 
     def _decode_tick(self):
@@ -1513,7 +2288,9 @@ class ServingEngine:
     def _record_tick(self, *, plan_ms: float, device_ms: float,
                      stream_ms: float, n_dec: int, prefill_tokens: int,
                      chunk: Optional[int], emitted: int, occupancy: int,
-                     queue_depth: int):
+                     queue_depth: int,
+                     draft_tokens: Optional[int] = None,
+                     accepted_tokens: Optional[int] = None):
         """Post-tick runtime introspection + the flight snapshot. The
         whole call is self-timed against tick wall time —
         ``stats()["flight"]["overhead_frac"]`` is that ratio, and
@@ -1554,6 +2331,11 @@ class ServingEngine:
                 "slots": self._slot_snaps(),
                 "recompiles": rec_total,
             }
+            if draft_tokens is not None:
+                # speculative ticks: proposals entering this tick's
+                # verify windows and how many survived rejection
+                snap["draft_tokens"] = draft_tokens
+                snap["accepted_tokens"] = accepted_tokens
             if mem is not None:
                 snap["mem"] = mem
             if self.paged:
@@ -1600,6 +2382,18 @@ class ServingEngine:
             # tensor-parallel degree of the tick bodies (1 = single-chip)
             "tp": self.tp,
         }
+        if self.spec:
+            out.update({
+                "draft": self.draft_kind,
+                "spec_k": self.spec_k,
+                "draft_tokens": self.draft_tokens_proposed,
+                "accepted_tokens": self.draft_tokens_accepted,
+                "acceptance_rate": (
+                    round(self.draft_tokens_accepted
+                          / self.draft_tokens_proposed, 4)
+                    if self.draft_tokens_proposed else 0.0
+                ),
+            })
         if self.flight is not None:
             out["flight"] = {
                 "recorded": len(self.flight),

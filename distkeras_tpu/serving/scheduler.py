@@ -304,6 +304,37 @@ class FIFOScheduler:
             remain -= take
         return out
 
+    def plan_spec(self, n_decoding: int, pending_lens: Sequence[int],
+                  chunk: int, want_widths: Sequence[int],
+                  ) -> Tuple[List[int], List[int]]:
+        """Budget split for one SPECULATIVE mixed tick: verify-window
+        tokens are charged against the same ``tick_token_budget`` as
+        prompt chunks, so chunked prefill and speculation coexist
+        without starving either. Order of claims:
+
+        1. every decoding slot reserves ONE token (the committed token
+           a verify tick emits at minimum — decode never stalls);
+        2. prefilling slots are dealt their prompt chunks from the
+           remainder, exactly as :meth:`plan_prefill`;
+        3. only budget left after prefill widens the speculative
+           windows (draft positions in the verify dispatch), dealt in
+           slot order up to each slot's requested width.
+
+        Prefill pressure therefore shrinks verify windows toward plain
+        1-token decode instead of the other way around. Returns
+        ``(prefill_takes, granted_widths)`` — one entry per
+        ``pending_lens`` / ``want_widths`` element respectively."""
+        takes = self.plan_prefill(n_decoding, pending_lens, chunk)
+        remain = max(
+            self.tick_token_budget - n_decoding - sum(takes), 0
+        )
+        widths: List[int] = []
+        for w in want_widths:
+            grant = min(int(w), remain)
+            widths.append(grant)
+            remain -= grant
+        return takes, widths
+
     def _expire(self, req: Request):
         """Finish a queued request whose deadline passed before a slot
         freed: full telemetry (the request must not vanish from trace

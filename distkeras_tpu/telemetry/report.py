@@ -221,6 +221,10 @@ def report_flight(path: str, last: Optional[int] = None,
         if "blocks" in r:
             b = r["blocks"]
             extra = f"  blocks={b.get('in_use')}/{b.get('free')}free"
+        if "draft_tokens" in r:
+            # speculative tick: accepted/proposed draft tokens
+            extra += (f"  spec={r.get('accepted_tokens')}"
+                      f"/{r.get('draft_tokens')}")
         out.write(
             f"  {r.get('tick', '?'):>7} "
             f"{r.get('t', 0.0) - base_t:>8.3f} "

@@ -209,6 +209,22 @@ def test_lm_serving_example_prefill_chunk_smoke(monkeypatch, capsys):
     assert "served 3 requests" in out
 
 
+def test_lm_serving_example_speculative_smoke(monkeypatch, capsys):
+    """--draft ngram: speculative verify ticks — every stream stays
+    parity-exact with solo generate() and the example surfaces the
+    proposed/accepted draft-token stats."""
+    sys.path.insert(0, "examples")
+    run_example(
+        monkeypatch, "lm_serving",
+        ["lm_serving.py", "--prompts", "3", "--max-new", "12",
+         "--slots", "2", "--prompt-len", "6", "--vocab", "16",
+         "--draft", "ngram", "--spec-k", "3"],
+    )
+    out = capsys.readouterr().out
+    assert out.count("parity OK") == 3
+    assert "speculation:" in out and "draft=ngram" in out
+
+
 def test_lm_training_text_mode_smoke(monkeypatch, capsys, tmp_path):
     """--text end-to-end on a tiny corpus: byte-tokenize, train with the
     cosine schedule, report held-out perplexity, print a decoded
