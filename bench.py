@@ -314,6 +314,7 @@ def main():
     out.update(lm_bench())
     out.update(serve_interference_bench())
     out.update(serve_speculative_bench())
+    out.update(serve_router_bench())
     print(json.dumps(out))
 
 
@@ -380,6 +381,48 @@ def serve_speculative_bench():
         }
     except Exception as e:  # pragma: no cover - accelerator-dependent
         return {"serve_spec_error": f"{type(e).__name__}: {e}"}
+
+
+def serve_router_bench():
+    """Multi-replica fabric numbers for the BENCH trajectory: aggregate
+    throughput scaling of 3 routed replicas vs 1, fleet
+    prefix-hit-fraction under affine vs random routing, and the
+    failover outcome. Self-asserts are off (``checks=False``) and
+    errors are folded into the JSON, same policy as the other serving
+    lines — a fabric regression must show up as a worse number, never
+    as a missing flagship line."""
+    import os
+    import sys
+
+    sys.path.insert(
+        0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "benchmarks"))
+    try:
+        import serve_bench
+
+        # respawn-with-forced-host-devices path needs the subprocess's
+        # own checks off too, so call bench_router directly when the
+        # device count allows and fall back to the respawn otherwise
+        r = serve_bench.run_router(smoke=True, checks=False)
+        return {
+            "serve_router_scaling": r["router_scaling"],
+            "serve_router_fleet_tokens_per_sec":
+                r["fleet_tokens_per_sec"],
+            "serve_router_single_tokens_per_sec":
+                r["single_tokens_per_sec"],
+            "serve_router_fleet_hit_affine": r["fleet_hit_affine"],
+            "serve_router_fleet_hit_random": r["fleet_hit_random"],
+            "serve_router_single_hit_reference":
+                r["single_hit_reference"],
+            "serve_router_failover_streams_lost":
+                r["failover_streams_lost"],
+            "serve_router_failover_failed_over":
+                r["failover_failed_over"],
+            "serve_router_parity": r["parity"],
+            "serve_router_config": r["config"],
+        }
+    except Exception as e:  # pragma: no cover - accelerator-dependent
+        return {"serve_router_error": f"{type(e).__name__}: {e}"}
 
 
 if __name__ == "__main__":

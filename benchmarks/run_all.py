@@ -393,9 +393,40 @@ def config11():
     }))
 
 
+def config12():
+    """Multi-replica serving fabric: 3 in-process LMServer replicas
+    behind the prefix-affinity Router vs one replica
+    (benchmarks/serve_bench.py --router; the --smoke variant
+    self-asserts >=2.4x aggregate throughput scaling, affine fleet
+    prefix_hit_fraction within 10% of the single-replica reference
+    with random routing measurably worse, and kill-one-replica
+    failover losing zero accepted streams)."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import serve_bench
+
+    out = serve_bench.run_router(smoke=True)
+    print(json.dumps({
+        "config": 12, "metric": "serving_router_throughput_scaling",
+        "value": out["router_scaling"],
+        "unit": "x (aggregate tok/s, 3 replicas / 1)",
+        "fleet_tokens_per_sec": out["fleet_tokens_per_sec"],
+        "single_tokens_per_sec": out["single_tokens_per_sec"],
+        "fleet_hit_affine": out["fleet_hit_affine"],
+        "fleet_hit_random": out["fleet_hit_random"],
+        "single_hit_reference": out["single_hit_reference"],
+        "failover_streams_lost": out["failover_streams_lost"],
+        "failover_failed_over": out["failover_failed_over"],
+        "parity": out["parity"],
+        "n_devices": out["n_devices"],
+        "backend": out["backend"],
+        "model": out["config"],
+        "data": "synthetic-shared-prefix-closed-loop-trace",
+    }))
+
+
 CONFIGS = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5,
            6: config6, 7: config7, 8: config8, 9: config9, 10: config10,
-           11: config11}
+           11: config11, 12: config12}
 
 
 def main():

@@ -13,7 +13,12 @@ live decode streams. With ``ServingEngine(paged=True)`` the slot slabs
 become a pool of fixed-size KV blocks (:mod:`kvpool`) with radix-tree
 prompt-prefix sharing (:mod:`prefix`): repeated system prompts are
 prefilled once and reference-counted, with copy-on-write at mid-block
-divergence and LRU eviction of unreferenced cached blocks.
+divergence and LRU eviction of unreferenced cached blocks. Above the
+single engine sits the multi-replica fabric: a :class:`Router`
+(:mod:`router`) fronting N replicas over the same wire protocol —
+prefix-affine routing, load-aware spill, replay-based failover, and
+graceful drain — with replica health/load management and fleet
+stats/metrics aggregation in :mod:`fleet`.
 """
 
 from distkeras_tpu.serving.engine import ServingEngine  # noqa: F401
@@ -27,15 +32,25 @@ from distkeras_tpu.serving.prefix import (  # noqa: F401
 )
 from distkeras_tpu.serving.scheduler import (  # noqa: F401
     DEFAULT_PREFILL_CHUNK,
+    DrainingError,
     FIFOScheduler,
     QueueFullError,
     Request,
     TokenStream,
 )
 from distkeras_tpu.serving.server import (  # noqa: F401
+    DISCONNECTED,
     LMServer,
+    OverloadedError,
     ServingClient,
+    ServingConnectionError,
 )
+from distkeras_tpu.serving.fleet import (  # noqa: F401
+    Replica,
+    ReplicaManager,
+    merge_metric_snapshots,
+)
+from distkeras_tpu.serving.router import Router  # noqa: F401
 
 __all__ = [
     "ServingEngine",
@@ -46,8 +61,16 @@ __all__ = [
     "RadixPrefixIndex",
     "FIFOScheduler",
     "QueueFullError",
+    "DrainingError",
+    "OverloadedError",
+    "ServingConnectionError",
+    "DISCONNECTED",
     "Request",
     "TokenStream",
     "LMServer",
     "ServingClient",
+    "Replica",
+    "ReplicaManager",
+    "merge_metric_snapshots",
+    "Router",
 ]

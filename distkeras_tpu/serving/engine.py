@@ -88,6 +88,7 @@ from distkeras_tpu.serving.kvpool import BlockPool
 from distkeras_tpu.serving.prefix import RadixPrefixIndex
 from distkeras_tpu.serving.scheduler import (
     DEFAULT_PREFILL_CHUNK,
+    DrainingError,
     FIFOScheduler,
     Request,
 )
@@ -871,6 +872,13 @@ class ServingEngine:
       spec_k: draft tokens proposed per row per tick (default 4).
       ngram_max: longest suffix n-gram the ``"ngram"`` drafter matches
         (default 3).
+      device: pin this engine's device-side state (weights, cache,
+        logits, RNG chains) to one specific :class:`jax.Device` — the
+        multi-replica pattern, where N single-chip engines in one
+        process each own a device and their ticks dispatch
+        independently. Default: the process's first local device.
+        Mutually exclusive with ``mesh`` (a tensor-parallel engine
+        spans its mesh's devices).
 
     Drive it with :meth:`step` (one admit→tick→complete→refill cycle,
     e.g. from a test) or :meth:`serve_forever` (the TCP front-end's
@@ -893,7 +901,7 @@ class ServingEngine:
                  mesh=None, tp_axis: str = "model",
                  paged_kernel: str = "auto",
                  draft=None, draft_params=None, spec_k: int = 4,
-                 ngram_max: int = 3):
+                 ngram_max: int = 3, device=None):
         if slots < 1:
             raise ValueError(f"slots must be >= 1; got {slots}")
         if prefill_chunk is not None and prefill_chunk < 1:
@@ -986,7 +994,13 @@ class ServingEngine:
         else:
             self.flight = flight or None
         self._mem = MemoryWatermarks()
-        self._device = jax.local_devices()[0]
+        if device is not None and mesh is not None:
+            raise ValueError(
+                "device= and mesh= are mutually exclusive: a "
+                "tensor-parallel engine spans its mesh's devices; "
+                "per-replica device pinning is for single-chip engines"
+            )
+        self._device = device if device is not None else jax.local_devices()[0]
         self._recompile_mark = recompiles.mark()
         self._flight_ns = 0  # time spent building/recording snapshots
         self._tick_ns = 0    # total tick wall time (plan+device+stream)
@@ -1124,10 +1138,29 @@ class ServingEngine:
             (slots, self.model.vocab_size), jnp.float32
         )
         self._rngs = jnp.zeros((slots, 2), jnp.uint32)
+        if device is not None:
+            # commit every device-side buffer to the pinned device: the
+            # jitted ticks follow their committed inputs, so N replica
+            # engines in one process dispatch onto N distinct devices
+            # (host numpy args — fed tokens, block tables — are
+            # uncommitted and follow along)
+            self._params_only = jax.device_put(self._params_only, device)
+            self._cache = jax.device_put(self._cache, device)
+            self._last_logits = jax.device_put(self._last_logits, device)
+            self._rngs = jax.device_put(self._rngs, device)
+            self._draft_rngs = jax.device_put(self._draft_rngs, device)
+            if self._dm_draft is not None:
+                self._draft_params_only = jax.device_put(
+                    self._draft_params_only, device)
+                self._draft_cache = jax.device_put(self._draft_cache,
+                                                   device)
         self._ctx: Optional[_ShardCtx] = None
         if mesh is not None:
             self._init_mesh_ctx()
         self._slots: List[Optional[_SlotState]] = [None] * slots
+        # graceful drain: begin_drain() closes admissions (new submits
+        # raise DrainingError) while queued + in-flight requests finish
+        self.draining = False
         # counters (host-side observability; per-engine, unlike the
         # process-cumulative registry series)
         self.ticks = 0
@@ -1311,8 +1344,14 @@ class ServingEngine:
                top_k: Optional[int] = None, top_p: Optional[float] = None,
                deadline_s: Optional[float] = None) -> Request:
         """Queue one request; returns it (consume ``request.stream``).
-        Raises :class:`QueueFullError` under backpressure and
+        Raises :class:`QueueFullError` under backpressure,
+        :class:`DrainingError` after :meth:`begin_drain`, and
         ``ValueError`` for requests that can never fit the cache."""
+        if self.draining:
+            raise DrainingError(
+                "engine is draining: admissions are closed, in-flight "
+                "streams are finishing"
+            )
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size < 1:
             raise ValueError("prompt must contain at least one token")
@@ -1409,6 +1448,23 @@ class ServingEngine:
         while self.step():
             if time.monotonic() > deadline:
                 raise TimeoutError("engine did not drain in time")
+
+    def begin_drain(self):
+        """Close admissions for a graceful shutdown: subsequent
+        :meth:`submit` calls raise :class:`DrainingError`, while queued
+        and in-flight requests keep streaming to completion under the
+        normal loop. Progress is visible in :meth:`stats`:
+        ``draining`` flips True here, ``drained`` once the queue and
+        every slot are empty. Idempotent; served over TCP as the
+        ``drain`` op (:meth:`ServingClient.drain`)."""
+        self.draining = True
+
+    @property
+    def drained(self) -> bool:
+        """True once a draining engine has finished all accepted work
+        (no queued requests, every slot free)."""
+        return (self.draining and self.scheduler.depth() == 0
+                and all(st is None for st in self._slots))
 
     def watchdog(self, timeout_s: float = 30.0,
                  interval_s: Optional[float] = None) -> StallWatchdog:
@@ -2358,6 +2414,12 @@ class ServingEngine:
             "requests_completed": self.requests_completed,
             "tokens_generated": self.tokens_generated,
             "queue_depth": self.scheduler.depth(),
+            "active_slots": sum(1 for st in self._slots if st is not None),
+            # graceful-drain state (begin_drain closes admissions; the
+            # router routes around draining replicas, deploy tooling
+            # polls for drained before stopping the process)
+            "draining": self.draining,
+            "drained": self.drained,
             "mean_occupancy": (
                 round(self._occ_sum / self.ticks, 3) if self.ticks else 0.0
             ),
@@ -2403,9 +2465,15 @@ class ServingEngine:
                     / max(self._tick_ns + self._flight_ns, 1), 5),
             }
         if self.paged:
+            pool = self.pool.stats()
             out.update({
                 "blocks_in_use": self.pool.in_use_count(),
                 "blocks_free": self.pool.free_count(),
+                # free + cached-unreferenced: what an admission could
+                # actually obtain. The router's block-pool saturation
+                # signal — a transiently empty free list with a warm
+                # prefix cache is NOT saturation
+                "blocks_reclaimable": pool["free"] + pool["cached"],
                 "prompt_tokens": self.prompt_tokens,
                 "prefix_hit_tokens": self.prefix_hit_tokens,
                 "prefix_hit_fraction": (

@@ -1,0 +1,387 @@
+"""Replica management for the multi-replica serving fabric.
+
+The reference system's soul is a coordinator distributing work to
+workers over sockets; this module is that shape grafted onto serving:
+the :class:`Router` (:mod:`distkeras_tpu.serving.router`) is the
+coordinator, and each worker is one :class:`~distkeras_tpu.serving.LMServer`
+replica reachable over the framed-msgpack wire protocol. What lives
+here is everything the router needs to *know about* its fleet without
+caring how requests are routed:
+
+- :class:`Replica` — one backend: a persistent
+  :class:`~distkeras_tpu.serving.ServingClient` connection, a health
+  state (``healthy``/``suspect``/``down``/``draining``), the last
+  polled ``stats()`` snapshot (the router's load signal for spill
+  decisions), and reconnect bookkeeping.
+- :class:`ReplicaManager` — the probe loop: polls every replica's
+  ``stats`` op on an interval (one round trip doubles as health probe
+  and load sample), marks replicas suspect→down after consecutive
+  failures, reconnects downed replicas under exponential backoff, and
+  flips replicas to ``draining`` when their engine reports it. Publishes
+  per-replica gauges (``router_replica_up``/``_queue_depth``/
+  ``_active_slots``/``_blocks_in_use``) into the router's registry and
+  fires an ``on_down`` callback exactly once per connection death so
+  the router can trigger failover.
+- fleet aggregation — :meth:`ReplicaManager.aggregate_stats` (fleet
+  sums + per-replica snapshots), :meth:`~ReplicaManager.aggregate_metrics`
+  (per-replica :meth:`MetricRegistry.collect` snapshots merged by
+  :func:`merge_metric_snapshots`), and
+  :meth:`~ReplicaManager.aggregate_alerts` — the payloads of the
+  router's ``stats``/``metrics``/``alerts`` ops.
+
+Everything is stdlib-only, like the rest of the serving transport.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+from distkeras_tpu import telemetry
+from distkeras_tpu.serving.server import ServingClient
+
+# replica health states
+HEALTHY = "healthy"      # probed OK; eligible for routing
+SUSPECT = "suspect"      # one probe failed; still routed, watched
+DOWN = "down"            # consecutive probes failed / connection dead
+DRAINING = "draining"    # engine reports draining: no new routes
+
+# stats() keys summed into the fleet view (present-only: slot engines
+# have no block keys, non-speculative engines no draft keys)
+_SUM_KEYS = (
+    "ticks", "requests_completed", "tokens_generated", "queue_depth",
+    "active_slots", "prompt_tokens", "prefix_hit_tokens",
+    "blocks_in_use", "blocks_free", "blocks_reclaimable",
+    "draft_tokens", "accepted_tokens", "decode_stalls",
+)
+
+
+class Replica:
+    """One backend LM server as the router sees it. Thread-safety: the
+    ``client`` reference is swapped only by the manager's probe thread
+    (connect/reconnect) and by :meth:`mark_down`; readers snapshot it
+    once (``replica.client``) and rely on the client's own terminal
+    :data:`~distkeras_tpu.serving.DISCONNECTED` frames when it dies
+    under them."""
+
+    def __init__(self, host: str, port: int, name: Optional[str] = None,
+                 request_timeout: float = 60.0):
+        self.host, self.port = host, int(port)
+        self.name = name or f"{host}:{port}"
+        self.request_timeout = request_timeout
+        self.client: Optional[ServingClient] = None
+        self.state = DOWN          # until the first successful probe
+        self.last_stats: Dict = {}
+        self.failures = 0          # consecutive probe failures
+        self.next_attempt_t = 0.0  # monotonic gate for backoff
+        self.backoff_s = 0.0
+        self.generation = 0        # bumps per connection death
+        self._lock = threading.Lock()
+
+    def connect(self) -> ServingClient:
+        """(Re)establish the backend connection. Socket timeout None:
+        a router's backend connection may sit idle between requests and
+        must not be torn down by a read deadline — liveness comes from
+        request-level timeouts and the probe loop."""
+        client = ServingClient(self.host, self.port, timeout=None,
+                               request_timeout=self.request_timeout)
+        with self._lock:
+            self.client = client
+        return client
+
+    def mark_down(self, reason: str = ""):
+        """Declare the replica dead: close the client (its reader seeds
+        terminal DISCONNECTED frames, unblocking every proxied stream so
+        failover can replay them) and bump the generation. Idempotent
+        per connection."""
+        with self._lock:
+            client, self.client = self.client, None
+            if self.state != DOWN:
+                self.generation += 1
+            self.state = DOWN
+        if client is not None:
+            client.close()
+
+    def snapshot(self) -> Dict:
+        """Plain-data view for the aggregated stats op."""
+        return {"state": self.state, "host": self.host, "port": self.port,
+                **({"stats": self.last_stats} if self.last_stats else {})}
+
+
+def merge_metric_snapshots(snapshots: Sequence[Dict[str, dict]],
+                           ) -> Dict[str, dict]:
+    """Merge :meth:`MetricRegistry.collect` snapshots from N replicas
+    into one fleet view: series with identical labels are summed —
+    counters and gauges by value, histograms bucket-by-bucket (plus sum
+    and count). Families whose type/labelnames disagree across replicas
+    are kept from the first snapshot only (a version-skewed replica must
+    not corrupt the fleet view). Gauges are summed because every gauge
+    the serving stack exports (blocks in use, queue depth, occupancy)
+    is an additive resource quantity; a non-additive gauge belongs in
+    per-replica stats, not the merged view."""
+    out: Dict[str, dict] = {}
+    for snap in snapshots:
+        for name, fam in snap.items():
+            cur = out.get(name)
+            if cur is None:
+                # deep-enough copy: we mutate series values below
+                out[name] = {
+                    "type": fam.get("type"), "help": fam.get("help"),
+                    "labelnames": list(fam.get("labelnames", [])),
+                    "series": [dict(s) for s in fam.get("series", [])],
+                }
+                continue
+            if (cur.get("type") != fam.get("type")
+                    or cur.get("labelnames")
+                    != list(fam.get("labelnames", []))):
+                continue  # skewed family: first replica wins
+            by_key = {tuple(sorted(s.get("labels", {}).items())): s
+                      for s in cur["series"]}
+            for s in fam.get("series", []):
+                key = tuple(sorted(s.get("labels", {}).items()))
+                have = by_key.get(key)
+                if have is None:
+                    s = dict(s)
+                    cur["series"].append(s)
+                    by_key[key] = s
+                elif cur["type"] in ("counter", "gauge"):
+                    have["value"] = (have.get("value", 0.0)
+                                     + s.get("value", 0.0))
+                elif cur["type"] == "histogram":
+                    hb, sb = have.get("buckets", {}), s.get("buckets", {})
+                    have["buckets"] = {
+                        k: hb.get(k, 0) + sb.get(k, 0)
+                        for k in set(hb) | set(sb)
+                    }
+                    have["sum"] = round(
+                        have.get("sum", 0.0) + s.get("sum", 0.0), 6)
+                    have["count"] = (have.get("count", 0)
+                                     + s.get("count", 0))
+    return out
+
+
+class ReplicaManager:
+    """Health probing, load polling, and fleet aggregation over a set
+    of :class:`Replica` backends.
+
+    One ``stats`` round trip per replica per ``poll_interval`` serves
+    three masters: it is the liveness probe (a replica that cannot
+    answer within ``probe_timeout`` is suspect; ``down_after``
+    consecutive failures downs it), the load sample the router's spill
+    decision reads (``last_stats``), and the drain detector (an engine
+    reporting ``draining`` stops receiving new routes without being
+    treated as failed). Downed replicas are reconnected under
+    exponential backoff (``backoff_base`` doubling to ``backoff_max``)
+    and return to ``healthy`` on the first good probe.
+
+    ``on_down(replica)`` fires exactly once per connection death,
+    *after* the replica's client has been closed — by then every stream
+    proxied from it has already received its terminal DISCONNECTED
+    frame, so the callback (the router's failover hook) races nothing.
+    """
+
+    def __init__(self, replicas: Sequence[Replica],
+                 poll_interval: float = 0.25,
+                 probe_timeout: float = 5.0,
+                 down_after: int = 2,
+                 backoff_base: float = 0.2,
+                 backoff_max: float = 5.0,
+                 registry: Optional[telemetry.MetricRegistry] = None,
+                 on_down: Optional[Callable[[Replica], None]] = None):
+        if not replicas:
+            raise ValueError("ReplicaManager needs at least one replica")
+        names = [r.name for r in replicas]
+        if len(set(names)) != len(names):
+            raise ValueError(f"replica names must be unique; got {names}")
+        self.replicas: List[Replica] = list(replicas)
+        self.poll_interval = poll_interval
+        self.probe_timeout = probe_timeout
+        self.down_after = max(int(down_after), 1)
+        self.backoff_base = backoff_base
+        self.backoff_max = backoff_max
+        self.registry = registry or telemetry.get_registry()
+        self.on_down = on_down
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._m_up = self.registry.gauge(
+            "router_replica_up",
+            "1 when the replica answers probes, else 0",
+            labelnames=("replica",),
+        )
+        self._m_depth = self.registry.gauge(
+            "router_replica_queue_depth",
+            "last polled admission-queue depth per replica",
+            labelnames=("replica",),
+        )
+        self._m_active = self.registry.gauge(
+            "router_replica_active_slots",
+            "last polled occupied decode slots per replica",
+            labelnames=("replica",),
+        )
+        self._m_blocks = self.registry.gauge(
+            "router_replica_blocks_in_use",
+            "last polled KV blocks in use per replica (paged engines)",
+            labelnames=("replica",),
+        )
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> "ReplicaManager":
+        """One synchronous probe pass (so the router starts with a live
+        view), then the background loop."""
+        self.probe_all()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        for r in self.replicas:
+            client = r.client
+            if client is not None:
+                client.close()
+
+    def _loop(self):
+        while not self._stop.wait(self.poll_interval):
+            self.probe_all()
+
+    # -- probing ------------------------------------------------------------
+
+    def probe_all(self):
+        for r in self.replicas:
+            if self._stop.is_set():
+                return
+            self.probe(r)
+
+    def probe(self, r: Replica):
+        """One health/load round trip for one replica (respects the
+        reconnect backoff gate for downed replicas)."""
+        now = time.monotonic()
+        if r.state == DOWN and now < r.next_attempt_t:
+            return
+        try:
+            client = r.client
+            if client is None or client.closed:
+                client = r.connect()
+            stats = client._call({"op": "stats"},
+                                 timeout=self.probe_timeout)["stats"]
+        except Exception:
+            r.failures += 1
+            if r.state == DOWN or r.failures >= self.down_after:
+                self._down(r)
+            else:
+                r.state = SUSPECT
+            self._m_up.labels(replica=r.name).set(0)
+            return
+        r.failures = 0
+        r.backoff_s = 0.0
+        r.last_stats = dict(stats)
+        r.state = DRAINING if stats.get("draining") else HEALTHY
+        self._m_up.labels(replica=r.name).set(1)
+        self._m_depth.labels(replica=r.name).set(
+            stats.get("queue_depth", 0))
+        self._m_active.labels(replica=r.name).set(
+            stats.get("active_slots", 0))
+        if "blocks_in_use" in stats:
+            self._m_blocks.labels(replica=r.name).set(
+                stats["blocks_in_use"])
+
+    def note_failure(self, r: Replica):
+        """The router observed a hard failure on this replica (send
+        failed, connection refused mid-submit): down it now instead of
+        waiting for the next probe round."""
+        self._down(r)
+        self._m_up.labels(replica=r.name).set(0)
+
+    def _down(self, r: Replica):
+        was_down = r.state == DOWN
+        r.mark_down()
+        r.backoff_s = (min(max(r.backoff_s * 2, self.backoff_base),
+                           self.backoff_max))
+        r.next_attempt_t = time.monotonic() + r.backoff_s
+        if not was_down and self.on_down is not None:
+            try:
+                self.on_down(r)
+            except Exception:
+                pass  # a failover-hook bug must not kill the probe loop
+
+    # -- views --------------------------------------------------------------
+
+    def get(self, name: str) -> Replica:
+        for r in self.replicas:
+            if r.name == name:
+                return r
+        raise KeyError(f"no replica named {name!r}; have "
+                       f"{[r.name for r in self.replicas]}")
+
+    def routable(self) -> List[Replica]:
+        """Replicas eligible for NEW requests: healthy or suspect (a
+        single missed probe sheds no traffic), never down or
+        draining."""
+        return [r for r in self.replicas
+                if r.state in (HEALTHY, SUSPECT) and r.client is not None]
+
+    # -- aggregation --------------------------------------------------------
+
+    def aggregate_stats(self) -> Dict:
+        """Fleet sums over the last polled per-replica stats, plus the
+        per-replica snapshots. Down replicas contribute their last
+        known counters (totals stay monotone through a blip) and are
+        visible via their ``state``."""
+        fleet: Dict = {}
+        for r in self.replicas:
+            for k in _SUM_KEYS:
+                v = r.last_stats.get(k)
+                if v is not None:
+                    fleet[k] = fleet.get(k, 0) + v
+        hit, total = (fleet.get("prefix_hit_tokens"),
+                      fleet.get("prompt_tokens"))
+        if total and hit is not None:
+            fleet["prefix_hit_fraction"] = round(hit / total, 4)
+        fleet["replicas_total"] = len(self.replicas)
+        fleet["replicas_routable"] = len(self.routable())
+        return {
+            "fleet": fleet,
+            "replicas": {r.name: r.snapshot() for r in self.replicas},
+        }
+
+    def aggregate_metrics(self) -> Dict[str, dict]:
+        """Live ``metrics`` snapshots from every routable replica,
+        merged by :func:`merge_metric_snapshots`. A replica that fails
+        the fetch is skipped (and will fail its next probe)."""
+        snaps = []
+        for r in self.routable():
+            client = r.client
+            if client is None:
+                continue
+            try:
+                snaps.append(client._call(
+                    {"op": "metrics"}, timeout=self.probe_timeout
+                )["metrics"])
+            except Exception:
+                continue
+        return merge_metric_snapshots(snaps)
+
+    def aggregate_alerts(self) -> List[dict]:
+        """Every routable replica's SLO alerts, tagged with the replica
+        name (firing state is per-replica; the router adds no rules of
+        its own)."""
+        out: List[dict] = []
+        for r in self.routable():
+            client = r.client
+            if client is None:
+                continue
+            try:
+                alerts = client._call(
+                    {"op": "alerts"}, timeout=self.probe_timeout
+                )["alerts"]
+            except Exception:
+                continue
+            for a in alerts:
+                a = dict(a)
+                a["replica"] = r.name
+                out.append(a)
+        return out

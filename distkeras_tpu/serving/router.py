@@ -1,0 +1,815 @@
+"""Prefix-affinity router: one wire-compatible front door for N
+`LMServer` replicas.
+
+One continuous-batching engine is one chip's worth of serving; the
+fleet story needs a coordinator that looks exactly like a single
+server to clients. The :class:`Router` speaks the framed-msgpack
+protocol of :mod:`distkeras_tpu.serving.server` on the front (a plain
+:class:`~distkeras_tpu.serving.ServingClient` works against it
+unchanged) and holds persistent backend connections to N replicas via
+:class:`~distkeras_tpu.serving.fleet.ReplicaManager`. Per request it
+decides *where*, then proxies the token stream back, re-tagged with a
+router-scoped request id.
+
+Routing policy (``policy="affine"``, the default):
+
+1. **Prefix affinity.** A router-side
+   :class:`~distkeras_tpu.serving.prefix.RadixPrefixIndex` — the same
+   radix machinery each paged replica uses over KV blocks, here over
+   *synthetic* block ids mapped to replica names — matches the prompt's
+   leading ``block_size``-token chunks against previously routed
+   prompts. A hit of at least ``min_affinity_blocks`` chunks routes to
+   the replica whose radix KV cache already holds that prefix, so the
+   per-replica prefix caches keep paying off fleet-wide instead of
+   being diluted round-robin.
+2. **Consistent hashing** places cold prefixes: the first prompt chunk
+   hashes onto a ring of virtual nodes, so placement is deterministic
+   across router restarts and only ``1/N`` of keyspace moves when a
+   replica joins or dies.
+3. **Load-aware spill.** If the chosen replica's last polled stats
+   report saturation (queue depth ≥ ``spill_queue_depth``, or a paged
+   block pool with ≤ ``spill_min_free_blocks`` free), the request
+   spills to the least-loaded routable replica instead — affinity is a
+   preference, never a queue. A backend that still answers
+   ``overloaded`` triggers the same spill reactively, and only when
+   *every* routable replica refuses does the router return the typed
+   ``overloaded`` rejection to the client (fleet-level admission
+   control).
+
+Robustness:
+
+- **Health/failover.** The manager's probe loop downs replicas that
+  stop answering; downing closes the backend connection, which
+  delivers a terminal DISCONNECTED frame to every stream proxied from
+  it. Each stream's pump then *replays* its request on a surviving
+  replica — engines generate deterministically from (prompt, seed), so
+  the replay re-derives the identical stream and the pump forwards
+  only the tokens the client has not already seen. Not-yet-started
+  requests are thereby requeued with zero client-visible artifacts;
+  mid-stream requests resume seamlessly. Accepted streams are lost
+  only when every replica is gone.
+- **Graceful drain.** ``drain`` against the router closes router
+  admissions (in-flight streams finish); ``drain`` with a ``replica``
+  field forwards to that replica and stops routing to it — the
+  rolling-deploy primitive.
+
+Telemetry: ``router_*`` counters (routed per replica, spilled,
+failed-over, replayed tokens, failed, overload rejections) and
+per-replica health/load gauges live in the router's registry; the
+``stats`` op answers fleet sums + per-replica snapshots + the router
+section, ``metrics`` merges every replica's registry snapshot with the
+router's own, and ``alerts`` concatenates per-replica SLO alerts
+tagged by replica.
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+import itertools
+import random
+import socket
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from distkeras_tpu import telemetry
+from distkeras_tpu.networking import recv_msg, send_msg
+from distkeras_tpu.serving.fleet import (
+    DOWN,
+    DRAINING,
+    Replica,
+    ReplicaManager,
+    merge_metric_snapshots,
+)
+from distkeras_tpu.serving.prefix import RadixPrefixIndex
+from distkeras_tpu.serving.scheduler import DrainingError
+from distkeras_tpu.serving.server import (
+    DISCONNECTED,
+    MAX_SERVE_FRAME_BYTES,
+    OverloadedError,
+    ServingClient,
+    ServingConnectionError,
+    shutdown_close,
+)
+
+
+class _HashRing:
+    """Consistent hashing over replica names: ``vnodes`` virtual points
+    per replica on a 64-bit ring. Lookup walks clockwise from the
+    key's point to the first vnode whose replica is in the caller's
+    alive set — removing a replica only remaps the keys that pointed
+    at it."""
+
+    def __init__(self, names: Sequence[str], vnodes: int = 64):
+        points: List[Tuple[int, str]] = sorted(
+            (self._hash(f"{name}#{v}".encode()), name)
+            for name in names for v in range(vnodes)
+        )
+        self._hashes = [h for h, _ in points]
+        self._names = [n for _, n in points]
+
+    @staticmethod
+    def _hash(data: bytes) -> int:
+        return int.from_bytes(hashlib.sha1(data).digest()[:8], "big")
+
+    def lookup(self, key: bytes, alive: Set[str]) -> Optional[str]:
+        if not self._hashes or not alive:
+            return None
+        n = len(self._hashes)
+        i = bisect.bisect_right(self._hashes, self._hash(key))
+        for off in range(n):
+            name = self._names[(i + off) % n]
+            if name in alive:
+                return name
+        return None
+
+
+class _AllZero:
+    """Refcount view where every block is unreferenced — the router's
+    affinity index has no live pins; eviction order is pure LRU."""
+
+    def __getitem__(self, _):
+        return 0
+
+
+class _OwnerRef:
+    """Refcount view that pins every block except one owner's: feeding
+    this to ``evict_lru`` repeatedly strips exactly that owner's
+    reachable (leaf-first) nodes from the index."""
+
+    def __init__(self, owner_of: Dict[int, str], owner: str):
+        self._owner_of, self._owner = owner_of, owner
+
+    def __getitem__(self, b):
+        return 0 if self._owner_of.get(b) == self._owner else 1
+
+
+class PrefixAffinityIndex:
+    """Prompt-prefix → replica map on the
+    :class:`~distkeras_tpu.serving.prefix.RadixPrefixIndex` machinery.
+
+    Each radix node's "physical block" is a synthetic id mapped to the
+    replica that prompt chunk was routed to; a lookup walks the
+    prompt's full-chunk matches and reports the deepest chunk's owner
+    (the replica holding the *longest* cached prefix wins).
+    ``max_nodes`` bounds memory: beyond it, least-recently-matched
+    leaves are evicted — exactly the replicas' own cache discipline,
+    so the router's view of "who has this prefix" ages out roughly
+    when the replica's cache does. Callers synchronize (the router
+    holds its route lock); like the engine-side index, this class has
+    no locks of its own."""
+
+    def __init__(self, block_size: int = 16, max_nodes: int = 4096):
+        self.block_size = block_size
+        self.max_nodes = max_nodes
+        self._idx = RadixPrefixIndex(block_size)
+        self._owner_of: Dict[int, str] = {}
+        self._ids = itertools.count(1)
+
+    def __len__(self) -> int:
+        return len(self._idx)
+
+    def lookup(self, tokens) -> Tuple[Optional[str], int]:
+        """(owner of the deepest fully-matched chunk, matched tokens);
+        ``(None, 0)`` when no full chunk matches."""
+        m = self._idx.match(tokens)
+        for b in reversed(m.blocks):
+            owner = self._owner_of.get(b)
+            if owner is not None:
+                return owner, len(m.blocks) * self.block_size
+        return None, 0
+
+    def place(self, tokens, owner: str):
+        """Record that this prompt's chunks now live on ``owner``.
+        Chunks already present keep their existing owner (affinity
+        sticks to first placement — deterministic under concurrent
+        same-prefix requests), new chunks get fresh synthetic ids."""
+        n_full = len(tokens) // self.block_size
+        if n_full == 0:
+            return
+        ids = [next(self._ids) for _ in range(n_full)]
+        for b in self._idx.insert(tokens, ids):
+            self._owner_of[b] = owner
+        zero = _AllZero()
+        while len(self._idx) > self.max_nodes:
+            b = self._idx.evict_lru(zero)
+            if b is None:
+                break
+            self._owner_of.pop(b, None)
+
+    def forget(self, owner: str):
+        """Drop a dead replica's placements so its prefixes re-place
+        on survivors (interior nodes with living children of other
+        owners stay; lookups skip them via the health check)."""
+        ref = _OwnerRef(self._owner_of, owner)
+        while True:
+            b = self._idx.evict_lru(ref)
+            if b is None:
+                break
+            self._owner_of.pop(b, None)
+
+
+class _Entry:
+    """One client request in flight through the router."""
+
+    __slots__ = ("rid", "conn", "lock", "params", "trace_id", "replica",
+                 "client", "backend_rid", "skip", "n_backend",
+                 "delivered", "replays", "aborted", "t0")
+
+    def __init__(self, rid: int, conn, lock, params: dict, trace_id):
+        self.rid = rid
+        self.conn, self.lock = conn, lock
+        self.params = params          # enough to replay verbatim
+        self.trace_id = trace_id
+        self.replica: Optional[Replica] = None
+        self.client: Optional[ServingClient] = None
+        self.backend_rid: Optional[int] = None
+        self.skip = 0                 # replay: suppress first N tokens
+        self.n_backend = 0            # tokens seen from current attempt
+        self.delivered = 0            # tokens the client has received
+        self.replays = 0
+        self.aborted = False          # client connection gone
+        self.t0 = time.monotonic()
+
+
+class Router:
+    """Front a fleet of :class:`~distkeras_tpu.serving.LMServer`
+    replicas behind one wire-compatible endpoint (module docstring has
+    the full routing/failover story).
+
+    Args:
+      replicas: backends as ``(host, port)`` tuples (names default to
+        ``host:port``), ``(host, port, name)`` tuples, or prebuilt
+        :class:`~distkeras_tpu.serving.fleet.Replica` objects. All
+        replicas must serve the SAME model weights: failover replays
+        requests on survivors and relies on seeded decoding being
+        deterministic across replicas.
+      host/port: front-door bind (loopback by default, port 0 =
+        ephemeral; read ``router.port`` after construction).
+      policy: ``"affine"`` (radix affinity → consistent hash → spill),
+        ``"hash"`` (consistent hash only), or ``"random"`` (uniform —
+        the bench's control arm showing what affinity buys).
+      block_size: affinity granularity in tokens; match the replicas'
+        paged ``block_size`` so router chunks align with the blocks
+        replicas actually cache.
+      min_affinity_blocks: full chunks that must match before affinity
+        overrides the hash placement (default 1).
+      spill_queue_depth / spill_min_free_blocks: saturation thresholds
+        on the polled replica stats.
+      max_index_nodes: router-side radix size bound (LRU beyond it).
+      max_replays: failover replays attempted per request before its
+        stream is failed with reason ``"error"``.
+      poll_interval / probe_timeout / down_after / backoff_base /
+        backoff_max: forwarded to the
+        :class:`~distkeras_tpu.serving.fleet.ReplicaManager` probe loop.
+      backend_request_timeout: per-reply wait on backend connections
+        (acks and inter-token gaps).
+      registry / tracer: router-side telemetry sinks (defaults:
+        process-global).
+    """
+
+    def __init__(self, replicas: Sequence, host: str = "127.0.0.1",
+                 port: int = 0, policy: str = "affine",
+                 block_size: int = 16, min_affinity_blocks: int = 1,
+                 spill_queue_depth: int = 8,
+                 spill_min_free_blocks: int = 0,
+                 max_index_nodes: int = 4096, max_replays: int = 3,
+                 poll_interval: float = 0.25, probe_timeout: float = 5.0,
+                 down_after: int = 2, backoff_base: float = 0.2,
+                 backoff_max: float = 5.0,
+                 backend_request_timeout: float = 60.0,
+                 max_frame_bytes: int = MAX_SERVE_FRAME_BYTES,
+                 registry: Optional[telemetry.MetricRegistry] = None,
+                 tracer: Optional[telemetry.Tracer] = None,
+                 seed: int = 0):
+        if policy not in ("affine", "hash", "random"):
+            raise ValueError(
+                f"unknown policy {policy!r}: want 'affine', 'hash', or "
+                f"'random'"
+            )
+        self.policy = policy
+        self.registry = registry or telemetry.get_registry()
+        self.tracer = tracer or telemetry.get_tracer()
+        built: List[Replica] = []
+        for spec in replicas:
+            if isinstance(spec, Replica):
+                built.append(spec)
+            else:
+                built.append(Replica(
+                    *spec, request_timeout=backend_request_timeout))
+        self.manager = ReplicaManager(
+            built, poll_interval=poll_interval,
+            probe_timeout=probe_timeout, down_after=down_after,
+            backoff_base=backoff_base, backoff_max=backoff_max,
+            registry=self.registry, on_down=self._on_replica_down,
+        )
+        self.index = PrefixAffinityIndex(block_size=block_size,
+                                         max_nodes=max_index_nodes)
+        self.ring = _HashRing([r.name for r in built])
+        self.min_affinity_blocks = max(int(min_affinity_blocks), 1)
+        self.spill_queue_depth = spill_queue_depth
+        self.spill_min_free_blocks = spill_min_free_blocks
+        self.max_replays = max_replays
+        self.max_frame_bytes = max_frame_bytes
+        self._rng = random.Random(seed)
+        self._route_lock = threading.Lock()   # index + ring + rng
+        self._rid_counter = itertools.count(1)
+        self.draining = False
+        self._inflight: Dict[int, _Entry] = {}
+        self._inflight_lock = threading.Lock()
+        # front door
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self.port = self._sock.getsockname()[1]
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._conns: List[socket.socket] = []
+        self._conns_lock = threading.Lock()
+        # router telemetry
+        self._m_routed = self.registry.counter(
+            "router_requests_routed_total",
+            "requests routed, by replica and decision",
+            labelnames=("replica", "decision"),
+        )
+        self._m_spilled = self.registry.counter(
+            "router_requests_spilled_total",
+            "requests diverted off their preferred replica by load",
+        )
+        self._m_failed_over = self.registry.counter(
+            "router_requests_failed_over_total",
+            "requests moved off a dead replica, by whether tokens had "
+            "already streamed",
+            labelnames=("kind",),  # requeued | replayed
+        )
+        self._m_failovers = self.registry.counter(
+            "router_replica_failovers_total",
+            "replica-down events that triggered failover handling",
+        )
+        self._m_failed = self.registry.counter(
+            "router_requests_failed_total",
+            "accepted requests whose stream could not be completed",
+        )
+        self._m_overloaded = self.registry.counter(
+            "router_overload_rejections_total",
+            "submits rejected because every routable replica refused",
+        )
+        self._m_inflight = self.registry.gauge(
+            "router_inflight_requests",
+            "requests currently proxied through the router",
+        )
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> "Router":
+        self.manager.start()
+        self._sock.listen(128)
+        t = threading.Thread(target=self._accept_loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+        return self
+
+    def stop(self, timeout: float = 10.0):
+        self._stop.set()
+        # shutdown-first: a bare close() would leave the accept loop
+        # blocked in accept() until the join timeout
+        shutdown_close(self._sock)
+        with self._conns_lock:
+            conns = list(self._conns)
+        for c in conns:
+            shutdown_close(c)
+        self.manager.stop()
+        for t in self._threads:
+            t.join(timeout)
+
+    # -- fleet events -------------------------------------------------------
+
+    def _on_replica_down(self, replica: Replica):
+        """Probe loop / note_failure downed a replica: the connection
+        close has already delivered DISCONNECTED to every proxied
+        stream (each pump replays itself); here we only retire the
+        dead replica's affinity placements so new same-prefix requests
+        re-place on survivors."""
+        self._m_failovers.inc()
+        with self._route_lock:
+            self.index.forget(replica.name)
+        self.tracer.record(None, "router.replica_down", time.monotonic(),
+                           0.0, replica=replica.name)
+
+    # -- routing ------------------------------------------------------------
+
+    def _saturated(self, r: Replica) -> bool:
+        s = r.last_stats
+        if s.get("queue_depth", 0) >= self.spill_queue_depth:
+            return True
+        # block-pool saturation = nothing obtainable: free blocks plus
+        # cached-unreferenced (evictable) ones. Falls back to the bare
+        # free count against older replicas that don't report it.
+        free = s.get("blocks_reclaimable", s.get("blocks_free"))
+        return free is not None and free <= self.spill_min_free_blocks
+
+    def _choose(self, prompt, exclude: Set[str],
+                ) -> Tuple[Replica, str]:
+        """Pick a target replica for one submit attempt. Returns
+        (replica, decision) with decision one of affine/hash/random/
+        spill. Raises ServingConnectionError when nothing is
+        routable."""
+        cands = [r for r in self.manager.routable()
+                 if r.name not in exclude]
+        if not cands:
+            raise ServingConnectionError(
+                f"no routable replica (fleet of "
+                f"{len(self.manager.replicas)}; excluded={sorted(exclude)})"
+            )
+        by_name = {r.name: r for r in cands}
+        with self._route_lock:
+            if self.policy == "random":
+                return self._rng.choice(cands), "random"
+            preferred, decision = None, "hash"
+            if self.policy == "affine":
+                owner, hit = self.index.lookup(prompt)
+                if (owner in by_name and hit
+                        >= self.min_affinity_blocks
+                        * self.index.block_size):
+                    preferred, decision = by_name[owner], "affine"
+            if preferred is None:
+                key = bytes(bytearray().join(
+                    int(t).to_bytes(4, "big", signed=False)
+                    for t in list(prompt)[: self.index.block_size]
+                ))
+                name = self.ring.lookup(key, set(by_name))
+                preferred = by_name[name] if name else cands[0]
+        if self._saturated(preferred):
+            relief = [r for r in cands
+                      if r is not preferred and not self._saturated(r)]
+            if relief:
+                target = min(relief, key=lambda r: (
+                    r.last_stats.get("queue_depth", 0),
+                    r.last_stats.get("active_slots", 0),
+                ))
+                return target, "spill"
+        return preferred, decision
+
+    def _submit_routed(self, entry: _Entry, exclude: Set[str]):
+        """Route-and-submit with retries across the fleet. Typed
+        backend refusals (overloaded / draining / dead connection)
+        move to the next candidate; request-level errors (bad params)
+        propagate to the caller untouched. Raises OverloadedError when
+        every routable replica refused for load — the router's
+        admission-control boundary."""
+        overloaded: Optional[OverloadedError] = None
+        last_exc: Optional[Exception] = None
+        for _ in range(len(self.manager.replicas)):
+            try:
+                replica, decision = self._choose(entry.params["prompt"],
+                                                 exclude)
+            except ServingConnectionError as e:
+                last_exc = last_exc or e
+                break
+            client = replica.client
+            if client is None:
+                exclude.add(replica.name)
+                continue
+            try:
+                backend_rid = client.generate(
+                    entry.params["prompt"],
+                    entry.params["max_new_tokens"],
+                    **{k: v for k, v in entry.params.items()
+                       if k not in ("prompt", "max_new_tokens")},
+                )
+            except OverloadedError as e:
+                overloaded = e
+                exclude.add(replica.name)
+                continue
+            except DrainingError:
+                exclude.add(replica.name)
+                continue
+            except (ServingConnectionError, TimeoutError) as e:
+                self.manager.note_failure(replica)
+                last_exc = e
+                exclude.add(replica.name)
+                continue
+            entry.replica, entry.client = replica, client
+            entry.backend_rid = backend_rid
+            entry.n_backend = 0
+            if self.policy == "affine":
+                with self._route_lock:
+                    self.index.place(entry.params["prompt"], replica.name)
+            self._m_routed.labels(replica=replica.name,
+                                  decision=decision).inc()
+            if decision == "spill":
+                self._m_spilled.inc()
+            self.tracer.record(entry.trace_id, "router.route",
+                               time.monotonic(), 0.0,
+                               replica=replica.name, decision=decision,
+                               replay=entry.replays)
+            return
+        if overloaded is not None:
+            self._m_overloaded.inc()
+            raise overloaded
+        raise last_exc or ServingConnectionError(
+            "no routable replica accepted the request"
+        )
+
+    # -- stream proxy -------------------------------------------------------
+
+    @staticmethod
+    def _send(conn, lock, msg: dict):
+        with lock:
+            send_msg(conn, msg)
+
+    def _send_entry(self, entry: _Entry, msg: dict):
+        if entry.aborted:
+            return
+        try:
+            self._send(entry.conn, entry.lock, msg)
+        except (ConnectionError, OSError):
+            # client went away: keep draining backend frames silently
+            # (mirrors LMServer._pump), just stop forwarding
+            entry.aborted = True
+
+    def _pump(self, entry: _Entry):
+        """Forward one request's backend stream to the client,
+        replaying onto survivors when the backend dies mid-stream.
+        Replay skips the tokens the client already holds — seeded
+        decoding makes the replayed stream identical, so the client
+        sees one seamless stream regardless of how many replicas died
+        under it."""
+        reason: Optional[str] = None
+        while True:
+            client = entry.client
+            try:
+                for kind, val in client.frames(entry.backend_rid):
+                    if kind == "end":
+                        reason = val
+                        break
+                    entry.n_backend += 1
+                    if entry.n_backend > entry.skip:
+                        self._send_entry(
+                            entry, {"id": entry.rid, "t": int(val)})
+                        entry.delivered += 1
+            except TimeoutError:
+                # stalled backend: treat like a dead one
+                if entry.replica is not None:
+                    self.manager.note_failure(entry.replica)
+                reason = DISCONNECTED
+            if reason != DISCONNECTED:
+                break
+            # backend died mid-stream: fail over
+            dead = entry.replica
+            if dead is not None and dead.state != DOWN:
+                self.manager.note_failure(dead)
+            if entry.replays >= self.max_replays:
+                reason = "error"
+                self._m_failed.inc()
+                break
+            entry.replays += 1
+            self._m_failed_over.labels(
+                kind="replayed" if entry.delivered else "requeued"
+            ).inc()
+            self.tracer.record(entry.trace_id, "router.failover",
+                               time.monotonic(), 0.0,
+                               from_replica=(dead.name if dead else "?"),
+                               delivered=entry.delivered)
+            entry.skip = entry.delivered
+            try:
+                self._submit_routed(
+                    entry,
+                    exclude={dead.name} if dead is not None else set(),
+                )
+            except Exception:
+                reason = "error"
+                self._m_failed.inc()
+                break
+            reason = None
+        self._send_entry(entry, {
+            "id": entry.rid, "done": 1, "reason": reason,
+            "n": entry.delivered,
+        })
+        with self._inflight_lock:
+            self._inflight.pop(entry.rid, None)
+            self._m_inflight.set(len(self._inflight))
+        self.tracer.record(
+            entry.trace_id, "router.stream", entry.t0,
+            (time.monotonic() - entry.t0) * 1e3,
+            tokens=entry.delivered, reason=reason,
+            replays=entry.replays,
+        )
+
+    # -- front-door protocol ------------------------------------------------
+
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._conns_lock:
+                self._conns.append(conn)
+            t = threading.Thread(target=self._handle, args=(conn,),
+                                 daemon=True)
+            t.start()
+            self._threads = [x for x in self._threads if x.is_alive()]
+            self._threads.append(t)
+
+    def _handle(self, conn: socket.socket):
+        lock = threading.Lock()
+        pumps: List[threading.Thread] = []
+        try:
+            while not self._stop.is_set():
+                try:
+                    msg = recv_msg(conn, max_bytes=self.max_frame_bytes)
+                except Exception:
+                    return
+                if msg is None or not isinstance(msg, dict):
+                    return
+                op = msg.get("op")
+                try:
+                    if op == "generate":
+                        t = self._op_generate(conn, lock, msg)
+                        if t is not None:
+                            pumps.append(t)
+                    elif op == "stats":
+                        self._send(conn, lock,
+                                   {"ok": 1, "stats": self.stats()})
+                    elif op == "metrics":
+                        self._send(conn, lock,
+                                   {"ok": 1, "metrics": self.metrics()})
+                    elif op == "alerts":
+                        self._send(conn, lock, {
+                            "ok": 1,
+                            "alerts": self.manager.aggregate_alerts(),
+                        })
+                    elif op == "trace_dump":
+                        spans = self.tracer.dump(
+                            trace=(None if msg.get("trace") is None
+                                   else int(msg["trace"])),
+                            limit=(None if msg.get("limit") is None
+                                   else int(msg["limit"])),
+                        )
+                        self._send(conn, lock, {"ok": 1, "spans": spans})
+                    elif op == "drain":
+                        self._op_drain(conn, lock, msg)
+                    elif op == "flight":
+                        self._send(conn, lock, {
+                            "ok": 0,
+                            "error": "flight recorder lives per replica"
+                                     " — scrape replicas directly",
+                        })
+                    else:
+                        self._send(conn, lock, {
+                            "ok": 0, "error": f"unknown op {op!r}",
+                        })
+                except OverloadedError as e:
+                    self._send(conn, lock, {
+                        "ok": 0, "error": "overloaded",
+                        **({"queue_depth": e.queue_depth}
+                           if e.queue_depth is not None else {}),
+                    })
+                except DrainingError:
+                    self._send(conn, lock, {"ok": 0, "error": "draining"})
+                except ServingConnectionError as e:
+                    # a BACKEND connection problem is a reply to the
+                    # client, not a reason to drop the client's own
+                    # connection (which the next clause handles)
+                    self._send(conn, lock, {
+                        "ok": 0, "error": f"unavailable: {e}",
+                    })
+                except (ConnectionError, OSError):
+                    raise
+                except Exception as e:
+                    self._send(conn, lock, {
+                        "ok": 0, "error": f"{type(e).__name__}: {e}",
+                    })
+        except (ConnectionError, OSError):
+            return
+        finally:
+            for t in pumps:
+                t.join(timeout=5.0)
+            conn.close()
+            with self._conns_lock:
+                if conn in self._conns:
+                    self._conns.remove(conn)
+
+    def _op_generate(self, conn, lock, msg: dict,
+                     ) -> Optional[threading.Thread]:
+        if self.draining:
+            raise DrainingError("router is draining: admissions closed")
+        params = dict(
+            prompt=[int(t) for t in msg["prompt"]],
+            max_new_tokens=int(msg["max_new_tokens"]),
+            temperature=float(msg.get("temperature", 0.0)),
+            seed=int(msg.get("seed", 0)),
+        )
+        for k, cast in (("eos_id", int), ("top_k", int),
+                        ("top_p", float), ("deadline_s", float)):
+            if msg.get(k) is not None:
+                params[k] = cast(msg[k])
+        entry = _Entry(
+            rid=next(self._rid_counter), conn=conn, lock=lock,
+            params=params, trace_id=self.tracer.new_trace_id(),
+        )
+        self._submit_routed(entry, exclude=set())
+        with self._inflight_lock:
+            self._inflight[entry.rid] = entry
+            self._m_inflight.set(len(self._inflight))
+        # ack before the pump starts, so the acceptance frame always
+        # precedes the first token frame (same ordering as LMServer)
+        self._send(conn, lock, {"ok": 1, "id": entry.rid,
+                                "trace": entry.trace_id})
+        t = threading.Thread(target=self._pump, args=(entry,),
+                             daemon=True)
+        t.start()
+        return t
+
+    def _op_drain(self, conn, lock, msg: dict):
+        name = msg.get("replica")
+        if name is None:
+            # drain the ROUTER: no new admissions; in-flight streams
+            # finish; stats reports drained once the table empties
+            self.draining = True
+            with self._inflight_lock:
+                active = len(self._inflight)
+            self._send(conn, lock, {"ok": 1, "draining": 1,
+                                    "active": active, "queued": 0})
+            return
+        replica = self.manager.get(str(name))
+        client = replica.client
+        if client is None:
+            self._send(conn, lock, {
+                "ok": 0, "error": f"replica {name!r} is not connected",
+            })
+            return
+        reply = client.drain()
+        replica.state = DRAINING  # stop routing now, not at next poll
+        self._send(conn, lock, {"ok": 1, "draining": 1,
+                                "replica": replica.name, **reply})
+
+    # -- aggregated views ---------------------------------------------------
+
+    def stats(self) -> dict:
+        """Fleet sums at the top level (a client written against one
+        LMServer keeps finding ``requests_completed`` etc.), plus the
+        per-replica snapshots and the router's own section."""
+        agg = self.manager.aggregate_stats()
+        with self._inflight_lock:
+            inflight = len(self._inflight)
+            per_replica_inflight: Dict[str, int] = {}
+            for e in self._inflight.values():
+                if e.replica is not None:
+                    per_replica_inflight[e.replica.name] = (
+                        per_replica_inflight.get(e.replica.name, 0) + 1)
+        router = {
+            "policy": self.policy,
+            "inflight": inflight,
+            "inflight_by_replica": per_replica_inflight,
+            "draining": self.draining,
+            "drained": self.draining and inflight == 0,
+            "affinity_index_nodes": len(self.index),
+            "routed": self._counter_total("router_requests_routed_total"),
+            "spilled": self.registry.counter(
+                "router_requests_spilled_total").value,
+            "failed_over": self._counter_total(
+                "router_requests_failed_over_total"),
+            "failovers": self.registry.counter(
+                "router_replica_failovers_total").value,
+            "failed": self.registry.counter(
+                "router_requests_failed_total").value,
+            "overload_rejections": self.registry.counter(
+                "router_overload_rejections_total").value,
+        }
+        return {**agg["fleet"], "replicas": agg["replicas"],
+                "router": router}
+
+    def _counter_total(self, name: str) -> float:
+        fam = self.registry.get(name)
+        if fam is None:
+            return 0.0
+        return sum(s.get("value", 0.0)
+                   for s in fam.snapshot()["series"])
+
+    def metrics(self) -> Dict[str, dict]:
+        """Every replica's registry snapshot merged with the router's
+        own (router_* families live only here, serving_* families sum
+        across replicas)."""
+        return merge_metric_snapshots(
+            [self.registry.collect()]
+            + [self.manager.aggregate_metrics()]
+        )
+
+    # -- admin conveniences (host-side; the ops above are the wire API) -----
+
+    def drain_replica(self, name: str) -> dict:
+        """Drain one replica (rolling deploy): forward the drain op and
+        stop routing to it immediately."""
+        replica = self.manager.get(name)
+        client = replica.client
+        if client is None:
+            raise ServingConnectionError(
+                f"replica {name!r} is not connected"
+            )
+        reply = client.drain()
+        replica.state = DRAINING
+        return reply

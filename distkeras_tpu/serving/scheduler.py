@@ -46,7 +46,15 @@ DEFAULT_PREFILL_CHUNK = 64
 class QueueFullError(RuntimeError):
     """Admission queue is at ``max_queue_depth`` — the engine is not
     keeping up with arrivals. Callers should shed load or retry later;
-    the TCP front-end maps this to an error reply."""
+    the TCP front-end maps this to a structured ``overloaded`` reply
+    (spill-worthy backpressure, not a hard failure)."""
+
+
+class DrainingError(RuntimeError):
+    """The engine has closed admissions (:meth:`ServingEngine.begin_drain`):
+    in-flight and already-queued requests finish, new submits are
+    refused. The TCP front-end maps this to a structured ``draining``
+    reply so routers route around the replica during a clean deploy."""
 
 
 class TokenStream:

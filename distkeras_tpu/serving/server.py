@@ -17,10 +17,18 @@ Protocol (all frames are msgpack dicts):
     {"op": "trace_dump", "trace"?: tid, "limit"?: n}
     {"op": "flight", "last"?: n}              # flight-recorder ticks
     {"op": "alerts"}                          # SLO monitor state
+    {"op": "drain"}                           # close admissions (graceful)
 
   server → client
     {"ok": 1, "id": rid, "trace": tid}        # generate accepted
-    {"ok": 0, "error": msg}                   # rejected (e.g. backpressure)
+    {"ok": 0, "error": msg}                   # rejected (hard failure)
+    {"ok": 0, "error": "overloaded", "queue_depth": n}
+                                              # queue backpressure (typed:
+                                              # ServingClient raises
+                                              # OverloadedError — routers
+                                              # spill, callers back off)
+    {"ok": 0, "error": "draining"}            # admissions closed (typed:
+                                              # DrainingError)
     {"id": rid, "t": tok}                     # one streamed token
     {"id": rid, "done": 1, "reason": r, "n": k}   # stream end
     {"ok": 1, "stats": {...}}                 # stats reply
@@ -28,6 +36,7 @@ Protocol (all frames are msgpack dicts):
     {"ok": 1, "spans": [...]}                 # Tracer.dump()
     {"ok": 1, "flight": {"meta":..,"ticks":[..]}}   # FlightRecorder ring
     {"ok": 1, "alerts": [...]}                # SloMonitor.alerts()
+    {"ok": 1, "draining": 1, "active": a, "queued": q}   # drain accepted
 
 The ``trace`` id in the generate ack is the request's telemetry trace id
 (allocated at admission): ``trace_dump`` filtered to it returns the full
@@ -50,10 +59,55 @@ from typing import Dict, List, Optional, Tuple
 
 from distkeras_tpu.networking import connect, recv_msg, send_msg
 from distkeras_tpu.serving.engine import ServingEngine
-from distkeras_tpu.serving.scheduler import QueueFullError
+from distkeras_tpu.serving.scheduler import DrainingError, QueueFullError
 
 # serving frames are small (one token or one prompt); cap accordingly
 MAX_SERVE_FRAME_BYTES = 1 << 24  # 16 MiB
+
+# terminal stream-frame reason a ServingClient synthesizes when the
+# connection dies mid-stream (never sent by a server, whose genuine
+# finish reasons are eos/length/expired/error) — consumers that see it
+# know the stream was cut, not completed; the router's failover keys on
+# exactly this sentinel to replay the request on a surviving replica
+DISCONNECTED = "disconnected"
+
+
+def shutdown_close(sock: socket.socket):
+    """Close a socket that other threads may be blocked reading:
+    ``shutdown`` first, so the FIN goes out and blocked ``recv`` calls
+    unblock immediately — a bare ``close()`` while another thread sits
+    in ``recv`` leaves the file description held by the blocked
+    syscall, and the peer never sees EOF."""
+    try:
+        sock.shutdown(socket.SHUT_RDWR)
+    except OSError:
+        pass
+    try:
+        sock.close()
+    except OSError:
+        pass
+
+
+class OverloadedError(RuntimeError):
+    """The server refused a submit under queue backpressure (the
+    engine's :class:`~distkeras_tpu.serving.scheduler.QueueFullError`
+    surfaced over the wire as a structured ``overloaded`` reply).
+    Spill-worthy: a router retries on another replica, a direct caller
+    backs off and resubmits. ``queue_depth`` carries the server's queue
+    depth at rejection time when the server reported it."""
+
+    def __init__(self, msg: str, queue_depth=None):
+        super().__init__(msg)
+        self.queue_depth = queue_depth
+
+
+class ServingConnectionError(ConnectionError, RuntimeError):
+    """The TCP connection to an LM server could not be established or
+    died mid-use. Always names the ``host:port`` it concerns, so fleet
+    logs point at the replica, not just "connection reset". Inherits
+    ``RuntimeError`` as well: pre-typed callers caught RuntimeError
+    from ``_call`` rejections, and a dead connection must not slip past
+    them."""
 
 
 class LMServer:
@@ -82,6 +136,11 @@ class LMServer:
         self.port = self._sock.getsockname()[1]
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
+        # live client connections: stop() closes them so handler
+        # threads blocked in recv unblock immediately (clients see EOF
+        # at stop time, not whenever they next send a frame)
+        self._conns: List[socket.socket] = []
+        self._conns_lock = threading.Lock()
 
     def start(self) -> "LMServer":
         self._sock.listen(64)
@@ -101,10 +160,14 @@ class LMServer:
             self._watchdog.stop()
         if self.slo is not None:
             self.slo.stop()
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        # shutdown-first on the listener too: a bare close() leaves the
+        # accept loop blocked in accept() holding the file description,
+        # and its join below would burn the full timeout
+        shutdown_close(self._sock)
+        with self._conns_lock:
+            conns = list(self._conns)
+        for c in conns:
+            shutdown_close(c)
         for t in self._threads:
             t.join(timeout)
 
@@ -120,6 +183,8 @@ class LMServer:
             except OSError:
                 return
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._conns_lock:
+                self._conns.append(conn)
             t = threading.Thread(
                 target=self._handle, args=(conn,), daemon=True
             )
@@ -238,13 +303,32 @@ class LMServer:
                                   if self.slo is not None else [])
                         self._send(conn, lock,
                                    {"ok": 1, "alerts": alerts})
+                    elif op == "drain":
+                        # graceful drain: admissions close now; queued +
+                        # in-flight streams finish under the normal loop
+                        # (stats reports draining/drained progress)
+                        self.engine.begin_drain()
+                        st = self.engine.stats()
+                        self._send(conn, lock, {
+                            "ok": 1, "draining": 1,
+                            "active": st["active_slots"],
+                            "queued": st["queue_depth"],
+                        })
                     else:
                         self._send(conn, lock,
                                    {"ok": 0, "error": f"unknown op {op!r}"})
                 except (ConnectionError, OSError):
                     raise
-                except QueueFullError as e:
-                    self._send(conn, lock, {"ok": 0, "error": str(e)})
+                except QueueFullError:
+                    # structured so clients can tell spill-worthy
+                    # backpressure (retry elsewhere / later) from hard
+                    # failures; depth gives routers a load signal
+                    self._send(conn, lock, {
+                        "ok": 0, "error": "overloaded",
+                        "queue_depth": self.engine.scheduler.depth(),
+                    })
+                except DrainingError:
+                    self._send(conn, lock, {"ok": 0, "error": "draining"})
                 except Exception as e:
                     self._send(conn, lock, {
                         "ok": 0, "error": f"{type(e).__name__}: {e}"
@@ -255,6 +339,9 @@ class LMServer:
             for t in pumps:
                 t.join(timeout=5.0)
             conn.close()
+            with self._conns_lock:
+                if conn in self._conns:
+                    self._conns.remove(conn)
 
 
 class ServingClient:
@@ -262,36 +349,70 @@ class ServingClient:
     tokens. A reader thread demultiplexes tagged frames into per-request
     queues, so many requests can be in flight on one connection."""
 
-    def __init__(self, host: str, port: int, timeout: float = 60.0,
+    def __init__(self, host: str, port: int, timeout: Optional[float] = 60.0,
                  request_timeout: float = 60.0):
-        """``timeout`` bounds raw socket operations; ``request_timeout``
-        is the default wait for any reply — ack frames in :meth:`_call`
-        and per-token waits in :meth:`result` — inherited by every call
-        unless overridden per call. Expiries raise :class:`TimeoutError`
-        naming the operation/request."""
-        self._sock = connect(host, port)
+        """``timeout`` bounds raw socket operations (None = no socket
+        deadline — long-lived backend connections that may sit idle,
+        e.g. a router's, rely on request-level timeouts instead);
+        ``request_timeout`` is the default wait for any reply — ack
+        frames in :meth:`_call` and per-token waits in :meth:`result` —
+        inherited by every call unless overridden per call. Expiries
+        raise :class:`TimeoutError` naming the operation/request; a
+        refused or dead connection raises
+        :class:`ServingConnectionError` naming ``host:port``."""
+        self.host, self.port = host, int(port)
+        try:
+            self._sock = connect(host, port)
+        except OSError as e:
+            raise ServingConnectionError(
+                f"cannot connect to LM server at {host}:{port}: {e}"
+            ) from e
         self._sock.settimeout(timeout)
         self.request_timeout = request_timeout
+        # _call_lock serializes a request frame with ITS reply frame:
+        # ack frames carry no request id, so two threads interleaving
+        # send/recv on the ack queue would swap replies (a generate ack
+        # delivered to a stats caller maps tokens to the wrong rid)
+        self._call_lock = threading.Lock()
         self._send_lock = threading.Lock()
         self._acks: _queue.Queue = _queue.Queue()
         self._streams: Dict[int, _queue.Queue] = {}
         self._streams_lock = threading.Lock()
         self._trace_ids: Dict[int, int] = {}  # rid -> telemetry trace id
         self._closed = False
+        self._close_reason: Optional[str] = None
         self._reader = threading.Thread(target=self._read_loop, daemon=True)
         self._reader.start()
+
+    @property
+    def closed(self) -> bool:
+        """True once the connection is gone (locally closed or died)."""
+        return self._closed
+
+    @property
+    def close_reason(self) -> Optional[str]:
+        """Why the connection ended (None while it is alive)."""
+        return self._close_reason
 
     def _stream_q(self, rid: int) -> _queue.Queue:
         with self._streams_lock:
             if rid not in self._streams:
-                self._streams[rid] = _queue.Queue()
+                q = _queue.Queue()
+                if self._closed:
+                    # late consumer on a dead connection: hand it the
+                    # terminal frame immediately instead of letting it
+                    # block until its timeout
+                    q.put(("end", DISCONNECTED))
+                self._streams[rid] = q
             return self._streams[rid]
 
     def _read_loop(self):
+        reason = "closed by client"
         try:
             while True:
                 msg = recv_msg(self._sock)
                 if msg is None:
+                    reason = "server closed the connection"
                     break
                 if "t" in msg:
                     self._stream_q(int(msg["id"])).put(("tok", int(msg["t"])))
@@ -301,36 +422,79 @@ class ServingClient:
                     )
                 else:
                     self._acks.put(msg)
-        except (ConnectionError, OSError):
-            pass
+        except (ConnectionError, OSError) as e:
+            if not self._closed:  # a local close() races the recv error
+                reason = f"connection lost ({type(e).__name__}: {e})"
         finally:
-            self._closed = True
-            # unblock anyone waiting on a stream or an ack
+            # mark closed under the streams lock so _stream_q can never
+            # create a queue that misses both this sweep and the
+            # late-consumer seeding above
             with self._streams_lock:
+                self._closed = True
+                if self._close_reason is None:
+                    self._close_reason = reason
                 for q in self._streams.values():
-                    q.put(("end", "connection closed"))
-            self._acks.put({"ok": 0, "error": "connection closed"})
+                    q.put(("end", DISCONNECTED))
+            self._acks.put({"_disconnected": 1})
+
+    def _conn_error(self) -> ServingConnectionError:
+        return ServingConnectionError(
+            f"connection to LM server at {self.host}:{self.port} is "
+            f"closed ({self._close_reason or 'unknown reason'})"
+        )
 
     def _call(self, msg: dict, timeout: Optional[float] = None) -> dict:
         if timeout is None:
             timeout = self.request_timeout
-        with self._send_lock:
-            send_msg(self._sock, msg)
-        try:
-            reply = self._acks.get(timeout=timeout)
-        except _queue.Empty:
-            raise TimeoutError(
-                f"no reply to op {msg.get('op')!r} within {timeout}s"
-            ) from None
+        with self._call_lock:
+            if self._closed:
+                raise self._conn_error()
+            try:
+                with self._send_lock:
+                    send_msg(self._sock, msg)
+            except (ConnectionError, OSError) as e:
+                raise ServingConnectionError(
+                    f"send to LM server at {self.host}:{self.port} "
+                    f"failed: {e}"
+                ) from e
+            try:
+                reply = self._acks.get(timeout=timeout)
+            except _queue.Empty:
+                raise TimeoutError(
+                    f"no reply to op {msg.get('op')!r} within {timeout}s"
+                ) from None
+        if reply.get("_disconnected"):
+            # re-seed so every later caller fails fast instead of
+            # waiting out its timeout on an ack that can never come
+            self._acks.put(reply)
+            raise self._conn_error()
         if not reply.get("ok"):
-            raise RuntimeError(reply.get("error", "request rejected"))
+            err = reply.get("error", "request rejected")
+            if err == "overloaded":
+                depth = reply.get("queue_depth")
+                raise OverloadedError(
+                    f"server at {self.host}:{self.port} is overloaded"
+                    + (f" (queue_depth={depth})" if depth is not None
+                       else ""),
+                    queue_depth=depth,
+                )
+            if err == "draining":
+                raise DrainingError(
+                    f"server at {self.host}:{self.port} is draining "
+                    f"(admissions closed)"
+                )
+            raise RuntimeError(err)
         return reply
 
     def generate(self, prompt, max_new_tokens: int, **kw) -> int:
         """Submit one request; returns its id (stream via
         :meth:`stream` / :meth:`result`; telemetry trace id via
-        :meth:`trace_of`). Raises RuntimeError on rejection (e.g.
-        queue backpressure)."""
+        :meth:`trace_of`). Typed rejections: :class:`OverloadedError`
+        (queue backpressure — retry elsewhere/later),
+        :class:`~distkeras_tpu.serving.DrainingError` (admissions
+        closed), :class:`ServingConnectionError` (dead connection,
+        names host:port); anything else raises ``RuntimeError``. All
+        subclass RuntimeError, so untyped callers keep working."""
         msg = {"op": "generate",
                "prompt": [int(t) for t in prompt],
                "max_new_tokens": int(max_new_tokens)}
@@ -341,14 +505,39 @@ class ServingClient:
             self._trace_ids[rid] = int(reply["trace"])
         return rid
 
-    def stream(self, rid: int):
-        """Yield tokens for a request as they arrive."""
+    def frames(self, rid: int, timeout: Optional[float] = None):
+        """Yield a request's raw stream frames as ``(kind, value)``
+        pairs: ``("tok", token)`` per token, then exactly one terminal
+        ``("end", reason)`` — ``reason`` is the server's finish reason,
+        or the :data:`DISCONNECTED` sentinel if the connection died
+        mid-stream (a consumer is never left hanging). ``timeout``
+        bounds each inter-frame wait (default: the constructor's
+        ``request_timeout``); expiry raises :class:`TimeoutError`
+        naming the request. The router proxies on this; :meth:`stream`
+        and :meth:`result` are thin views over it."""
+        if timeout is None:
+            timeout = self.request_timeout
         q = self._stream_q(rid)
+        n = 0
         while True:
-            kind, val = q.get()
+            try:
+                kind, val = q.get(timeout=timeout)
+            except _queue.Empty:
+                raise TimeoutError(
+                    f"request {rid}: no token or end-of-stream within "
+                    f"{timeout}s (received {n} tokens)"
+                ) from None
+            yield kind, val
             if kind == "end":
                 return
-            yield val
+            n += 1
+
+    def stream(self, rid: int, timeout: Optional[float] = None):
+        """Yield tokens for a request as they arrive (ends on the
+        terminal frame, including a mid-stream disconnect)."""
+        for kind, val in self.frames(rid, timeout=timeout):
+            if kind == "tok":
+                yield val
 
     def result(self, rid: int, timeout: Optional[float] = None,
                ) -> Tuple[List[int], Optional[str]]:
@@ -356,22 +545,15 @@ class ServingClient:
         ``timeout`` bounds each inter-token wait (defaults to the
         constructor's ``request_timeout``); a stalled stream raises
         :class:`TimeoutError` naming the request instead of a bare
-        ``queue.Empty``."""
-        if timeout is None:
-            timeout = self.request_timeout
-        q = self._stream_q(rid)
+        ``queue.Empty``. A stream cut by a dead connection finishes
+        with ``finish_reason`` :data:`DISCONNECTED` rather than
+        hanging."""
         out: List[int] = []
-        while True:
-            try:
-                kind, val = q.get(timeout=timeout)
-            except _queue.Empty:
-                raise TimeoutError(
-                    f"request {rid}: no token or end-of-stream within "
-                    f"{timeout}s (received {len(out)} tokens)"
-                ) from None
+        for kind, val in self.frames(rid, timeout=timeout):
             if kind == "end":
                 return out, val
             out.append(val)
+        return out, None  # unreachable: frames always ends with "end"
 
     def stats(self) -> dict:
         return dict(self._call({"op": "stats"})["stats"])
@@ -409,8 +591,23 @@ class ServingClient:
         server has no monitor attached."""
         return list(self._call({"op": "alerts"})["alerts"])
 
+    def drain(self) -> dict:
+        """Gracefully drain the server: admissions close immediately
+        (subsequent :meth:`generate` calls raise
+        :class:`~distkeras_tpu.serving.DrainingError`), queued and
+        in-flight streams finish. Returns ``{"active": slots_busy,
+        "queued": depth}`` at drain time; poll :meth:`stats` for
+        ``drained`` before stopping the process."""
+        reply = self._call({"op": "drain"})
+        return {"active": int(reply.get("active", 0)),
+                "queued": int(reply.get("queued", 0))}
+
     def close(self):
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        """Idempotent: safe to call twice, or after the connection
+        already died (socket close is a no-op then). Shutdown-first so
+        the reader thread unblocks and seeds every pending stream with
+        its terminal frame."""
+        if not self._closed:
+            self._close_reason = "closed by client"
+            self._closed = True
+        shutdown_close(self._sock)

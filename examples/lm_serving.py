@@ -48,9 +48,20 @@ non-speculative engine — the parity check below covers it — and the
 printed stats show proposed/accepted draft tokens and the acceptance
 rate.
 
+``--replicas N`` serves through the multi-replica fabric: N in-process
+``LMServer`` replicas fronted by the prefix-affinity ``Router``, which
+speaks the same wire protocol (the client below connects to it
+unchanged). Prompts share a system prefix, so affine routing lands
+them all on the replica whose radix cache holds it — the printed fleet
+stats show the per-replica request distribution, the fleet prefix-hit
+fraction, and the router's routed/spilled/failed-over counters.
+Streams stay bit-identical to solo ``generate()`` through the extra
+hop.
+
 Run: python examples/lm_serving.py [--prompts 4] [--max-new 16] [--slots 2]
      [--telemetry-port 9100] [--paged] [--prefill-chunk 16] [--tp 2]
-     [--draft ngram] [--spec-k 4] [--flight-dump /tmp/flight.jsonl]
+     [--draft ngram] [--spec-k 4] [--replicas 3]
+     [--flight-dump /tmp/flight.jsonl]
 """
 
 import argparse
@@ -106,6 +117,11 @@ def main():
     ap.add_argument("--spec-k", type=int, default=4,
                     help="draft tokens proposed per row per tick "
                          "(default 4)")
+    ap.add_argument("--replicas", type=int, default=1,
+                    help="serve through the multi-replica fabric: this "
+                         "many in-process LMServer replicas behind the "
+                         "prefix-affinity Router (the client speaks "
+                         "the same protocol to it)")
     args = ap.parse_args()
 
     model = get_model(
@@ -173,14 +189,49 @@ def main():
               f"drafts rarely survive verification, so expect a low "
               f"acceptance rate; the point here is that streams stay "
               f"bit-identical anyway")
-    engine = ServingEngine(model, params, slots=args.slots, **engine_kw)
-    # SLO monitor (default serving rules) + stall watchdog: the server
-    # starts/stops both; alerts are served over the TCP "alerts" op
-    from distkeras_tpu.telemetry import SloMonitor, default_serving_rules
+    router = None
+    servers = []
+    if args.replicas > 1:
+        # multi-replica fabric: N replicas (own registries, so the
+        # fleet view below is a real aggregation) behind the Router
+        from distkeras_tpu import telemetry as tel
+        from distkeras_tpu.serving import Router
 
-    slo = SloMonitor(default_serving_rules(), registry=engine.registry,
-                     tracer=engine.tracer, interval_s=0.25)
-    server = LMServer(engine, slo=slo, watchdog_timeout_s=30.0).start()
+        for i in range(args.replicas):
+            eng = ServingEngine(
+                model, params, slots=args.slots,
+                registry=tel.MetricRegistry(), tracer=tel.Tracer(),
+                **engine_kw,
+            )
+            servers.append(LMServer(eng).start())
+        engine = servers[0].engine
+        router = Router(
+            [("127.0.0.1", s.port, f"r{i}")
+             for i, s in enumerate(servers)],
+            block_size=engine_kw.get("block_size", 16),
+            poll_interval=0.1,
+            registry=tel.MetricRegistry(), tracer=tel.Tracer(),
+        ).start()
+        slo = None
+        front_port = router.port
+        print(f"fabric: {args.replicas} replicas behind the router "
+              f"on port {front_port} (prefix-affine routing)")
+    else:
+        engine = ServingEngine(model, params, slots=args.slots,
+                               **engine_kw)
+        # SLO monitor (default serving rules) + stall watchdog: the
+        # server starts/stops both; alerts served over the TCP op
+        from distkeras_tpu.telemetry import (
+            SloMonitor,
+            default_serving_rules,
+        )
+
+        slo = SloMonitor(default_serving_rules(),
+                         registry=engine.registry,
+                         tracer=engine.tracer, interval_s=0.25)
+        servers.append(LMServer(engine, slo=slo,
+                                watchdog_timeout_s=30.0).start())
+        front_port = servers[0].port
     telemetry_server = None
     if args.telemetry_port is not None:
         from distkeras_tpu.telemetry import TelemetryServer
@@ -192,7 +243,7 @@ def main():
         ).start()
         print(f"telemetry: http://127.0.0.1:{telemetry_server.port}"
               f"/metrics (+ /metrics.json, /traces, /flight, /alerts)")
-    client = ServingClient("127.0.0.1", server.port)
+    client = ServingClient("127.0.0.1", front_port)
     try:
         rids = [client.generate(p, max_new_tokens=args.max_new)
                 for p in prompts]
@@ -209,18 +260,40 @@ def main():
             print(f"request {rid}: {toks} ({tag})")
             assert toks == solo, (toks, solo)
         stats = client.stats()
-        print(
-            f"served {stats['requests_completed']} requests, "
-            f"{total} tokens in {stats['ticks']} ticks "
-            f"(mean occupancy {stats['mean_occupancy']}, "
-            f"ttft p50 {stats['ttft_ms']['p50']:.1f}ms)"
-        )
+        if router is not None:
+            router.manager.probe_all()  # fresh per-replica counters
+            stats = client.stats()
+            served = {name: rep.get("stats", {}).get(
+                "requests_completed", 0)
+                for name, rep in stats["replicas"].items()}
+            print(
+                f"served {stats['requests_completed']} requests, "
+                f"{total} tokens across {stats['replicas_routable']} "
+                f"replicas (per replica: {served})"
+            )
+            r = stats["router"]
+            print(
+                f"router: {r['routed']:.0f} routed "
+                f"({r['spilled']:.0f} spilled, "
+                f"{r['failed_over']:.0f} failed over, "
+                f"{r['failed']:.0f} failed), "
+                f"affinity index {r['affinity_index_nodes']} nodes"
+            )
+        else:
+            print(
+                f"served {stats['requests_completed']} requests, "
+                f"{total} tokens in {stats['ticks']} ticks "
+                f"(mean occupancy {stats['mean_occupancy']}, "
+                f"ttft p50 {stats['ttft_ms']['p50']:.1f}ms)"
+            )
         if args.draft is not None:
+            rate = (stats["accepted_tokens"] / stats["draft_tokens"]
+                    if stats.get("draft_tokens") else 0.0)
             print(
                 f"speculation: {stats['accepted_tokens']}"
                 f"/{stats['draft_tokens']} draft tokens accepted "
-                f"(rate {stats['acceptance_rate']:.2f}, "
-                f"draft={stats['draft']}, k={stats['spec_k']})"
+                f"(rate {rate:.2f}, draft={args.draft}, "
+                f"k={args.spec_k})"
             )
         if args.paged:
             print(
@@ -237,16 +310,18 @@ def main():
                      if k not in ("trace", "span", "t0", "ms")}
             print(f"  trace {s['trace']} {s['span']:<8} {s['ms']:8.2f}ms "
                   + " ".join(f"{k}={v}" for k, v in attrs.items()))
-        # why was tick N slow? — the flight recorder's last ticks,
-        # phase-decomposed (host plan / device dispatch / stream fanout)
-        fl = client.flight(last=3)
-        print(f"flight recorder: {fl['meta']['recorded']} ticks retained; "
-              f"last {len(fl['ticks'])}:")
-        for t in fl["ticks"]:
-            print(f"  tick {t['tick']}: {t['tick_ms']:.2f}ms "
-                  f"(plan {t['plan_ms']:.2f} / device {t['device_ms']:.2f}"
-                  f" / stream {t['stream_ms']:.2f}), "
-                  f"occ {t['occupancy']}, emitted {t['emitted']}")
+        if router is None:
+            # why was tick N slow? — the flight recorder's last ticks,
+            # phase-decomposed (plan / device dispatch / stream fanout)
+            fl = client.flight(last=3)
+            print(f"flight recorder: {fl['meta']['recorded']} ticks "
+                  f"retained; last {len(fl['ticks'])}:")
+            for t in fl["ticks"]:
+                print(f"  tick {t['tick']}: {t['tick_ms']:.2f}ms "
+                      f"(plan {t['plan_ms']:.2f} / device "
+                      f"{t['device_ms']:.2f} / stream "
+                      f"{t['stream_ms']:.2f}), "
+                      f"occ {t['occupancy']}, emitted {t['emitted']}")
         alerts = client.alerts()
         firing = [a["rule"] for a in alerts if a["firing"]]
         print(f"slo: {len(alerts)} rules, "
@@ -258,7 +333,10 @@ def main():
                   f"--flight {args.flight_dump})")
     finally:
         client.close()
-        server.stop()
+        if router is not None:
+            router.stop()
+        for s in servers:
+            s.stop()
         if telemetry_server is not None:
             telemetry_server.stop()
 

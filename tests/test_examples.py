@@ -225,6 +225,25 @@ def test_lm_serving_example_speculative_smoke(monkeypatch, capsys):
     assert "speculation:" in out and "draft=ngram" in out
 
 
+def test_lm_serving_example_replicas_smoke(monkeypatch, capsys):
+    """--replicas 2: the multi-replica fabric — two in-process
+    LMServers behind the prefix-affinity Router, the same client code
+    unchanged — streams stay parity-exact and the example surfaces the
+    per-replica distribution and router counters."""
+    sys.path.insert(0, "examples")
+    run_example(
+        monkeypatch, "lm_serving",
+        ["lm_serving.py", "--prompts", "4", "--max-new", "8",
+         "--slots", "2", "--prompt-len", "8", "--vocab", "64",
+         "--paged", "--replicas", "2"],
+    )
+    out = capsys.readouterr().out
+    assert out.count("parity OK") == 4
+    assert "fabric: 2 replicas behind the router" in out
+    assert "per replica:" in out
+    assert "router:" in out and "routed" in out
+
+
 def test_lm_training_text_mode_smoke(monkeypatch, capsys, tmp_path):
     """--text end-to-end on a tiny corpus: byte-tokenize, train with the
     cosine schedule, report held-out perplexity, print a decoded

@@ -8,6 +8,7 @@ to the other TCP serving tests.
 
 import json
 import threading
+import time
 import urllib.error
 import urllib.request
 
@@ -334,7 +335,17 @@ def test_http_endpoint_scrapes_live_serving_and_ps():
         proxy.close()
         client.close()
 
-        code, text = _get(f"http://127.0.0.1:{http.port}/metrics")
+        # the PS service records op metrics in its handler's `finally`
+        # AFTER the reply frame is sent, so the scrape below can race
+        # the (descheduled) service thread — retry briefly before
+        # asserting on the exposition contents
+        deadline = time.monotonic() + 5.0
+        while True:
+            code, text = _get(f"http://127.0.0.1:{http.port}/metrics")
+            if ('ps_op_latency_ms_bucket{op="pull",le="+Inf"} 1' in text
+                    or time.monotonic() > deadline):
+                break
+            time.sleep(0.02)
         assert code == 200
         assert "serving_queue_depth" in text
         assert "serving_slot_occupancy" in text
