@@ -1,0 +1,72 @@
+"""Repo-native static analysis: the stack's invariants as code.
+
+``python -m distkeras_tpu.analysis`` runs five AST passes (stdlib
+``ast`` only — no third-party parser) over the package and checks the
+result against the checked-in baseline (``analysis-baseline.txt``):
+
+- ``lock-discipline`` — attributes written under ``with self.<lock>``
+  must always be accessed under the lock
+  (:mod:`distkeras_tpu.analysis.locks`);
+- ``donation-safety`` — buffers passed through ``donate_argnums`` jit
+  calls are dead unless rebound (:mod:`~distkeras_tpu.analysis.donation`);
+- ``rng-discipline`` — a PRNG key is consumed exactly once
+  (:mod:`~distkeras_tpu.analysis.rng`);
+- ``recompile-hazard`` — compile-cache keys stay hashable and
+  value-stable (:mod:`~distkeras_tpu.analysis.recompile`);
+- ``import-hygiene`` — stdlib-only layers stay stdlib-only; package
+  code never imports tests (:mod:`~distkeras_tpu.analysis.imports`).
+
+A finding is silenced either by a line-level suppression comment
+(``# analysis: <slug>``, e.g. ``# analysis: unguarded-ok``) for
+individually-justified sites, or by a baseline entry (rule/path/key +
+justification) for structural patterns. ``--strict`` (the CI lint
+job) exits 1 on any unbaselined finding, so the analyzer gates every
+PR while accepted findings stay visible and justified instead of
+silently ignored.
+
+The dynamic complement lives in
+:mod:`distkeras_tpu.analysis.lockorder`: an opt-in lock-order
+detector that instruments ``threading.Lock``/``RLock`` allocations in
+package code, records the per-thread acquisition graph while tests
+run, and fails on cycles (lock-order inversions). The serving,
+router, and telemetry suites enable it via a conftest fixture.
+"""
+
+from distkeras_tpu.analysis.core import (  # noqa: F401
+    AnalysisError,
+    Baseline,
+    Finding,
+    Pass,
+    SourceFile,
+    analyze,
+    split_by_baseline,
+)
+
+
+def default_passes():
+    """Fresh instances of every pass, in report order."""
+    from distkeras_tpu.analysis.donation import DonationSafetyPass
+    from distkeras_tpu.analysis.imports import ImportHygienePass
+    from distkeras_tpu.analysis.locks import LockDisciplinePass
+    from distkeras_tpu.analysis.recompile import RecompileHazardPass
+    from distkeras_tpu.analysis.rng import RngDisciplinePass
+
+    return [
+        LockDisciplinePass(),
+        DonationSafetyPass(),
+        RngDisciplinePass(),
+        RecompileHazardPass(),
+        ImportHygienePass(),
+    ]
+
+
+__all__ = [
+    "AnalysisError",
+    "Baseline",
+    "Finding",
+    "Pass",
+    "SourceFile",
+    "analyze",
+    "split_by_baseline",
+    "default_passes",
+]

@@ -1,0 +1,175 @@
+"""CLI for the static analysis suite.
+
+Check mode (the CI lint job)::
+
+    python -m distkeras_tpu.analysis [--strict] [paths...]
+    python -m distkeras_tpu.analysis --write-baseline
+
+Report mode (findings as data; same exit-code contract as
+``telemetry.report`` — bad input exits 2 with a one-line error, no
+traceback)::
+
+    python -m distkeras_tpu.analysis report [--json] [paths...]
+
+Defaults: scan the installed ``distkeras_tpu`` package; baseline at
+``analysis-baseline.txt`` next to the package (the repo root in a
+checkout), falling back to the current directory.
+
+Exit codes, check mode: 0 = clean or everything baselined; 1 =
+unbaselined findings under ``--strict`` (without it they are printed
+as warnings); 2 = unusable input. Report mode never fails on
+findings — it only reports them.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+import distkeras_tpu
+from distkeras_tpu.analysis import (
+    AnalysisError,
+    Baseline,
+    analyze,
+    split_by_baseline,
+)
+
+BASELINE_NAME = "analysis-baseline.txt"
+
+
+def default_root() -> str:
+    """The installed package directory — scanning it yields the same
+    ``distkeras_tpu/...`` relative paths as scanning a checkout."""
+    return os.path.dirname(os.path.abspath(distkeras_tpu.__file__))
+
+
+def default_baseline_path() -> Optional[str]:
+    for cand in (
+        os.path.join(os.path.dirname(default_root()), BASELINE_NAME),
+        os.path.join(os.getcwd(), BASELINE_NAME),
+    ):
+        if os.path.isfile(cand):
+            return cand
+    return None
+
+
+def _resolve(args) -> tuple:
+    roots = args.paths or [default_root()]
+    bl_path = args.baseline or default_baseline_path()
+    baseline = None
+    if bl_path and not args.no_baseline:
+        baseline = (Baseline.load(bl_path) if os.path.isfile(bl_path)
+                    else Baseline(path=bl_path))
+    return roots, bl_path, baseline
+
+
+def _render_table(findings, out) -> None:
+    for f in findings:
+        out.write(f.render() + "\n")
+
+
+def check_main(args) -> int:
+    roots, bl_path, baseline = _resolve(args)
+    findings = analyze(roots)
+    if args.write_baseline:
+        path = bl_path or os.path.join(os.getcwd(), BASELINE_NAME)
+        base = baseline or Baseline(path=path)
+        n = base.write(path, findings)
+        print(f"wrote {n} baseline entries to {path}")
+        return 0
+    new, accepted = split_by_baseline(findings, baseline)
+    if new:
+        _render_table(new, sys.stdout)
+    stale = baseline.stale(findings) if baseline else []
+    for fp in stale:
+        print("stale baseline entry (fixed? remove it): "
+              + "\t".join(fp))
+    print(
+        f"analysis: {len(findings)} finding(s) — {len(new)} new, "
+        f"{len(accepted)} baselined"
+        + (f", {len(stale)} stale baseline entr(y/ies)" if stale else "")
+    )
+    if new and args.strict:
+        print("strict mode: unbaselined findings fail the build "
+              "(suppress with '# analysis: <slug>' where justified, "
+              "or baseline with --write-baseline + a justification)")
+        return 1
+    return 0
+
+
+def report_main(args) -> int:
+    roots, _bl_path, baseline = _resolve(args)
+    findings = analyze(roots)
+    new, accepted = split_by_baseline(findings, baseline)
+    if args.json:
+        payload = {
+            "roots": [os.path.abspath(r) for r in roots],
+            "findings": [
+                {"rule": f.rule, "path": f.path, "line": f.line,
+                 "key": f.key, "message": f.message,
+                 "baselined": baseline.accepts(f) if baseline else False}
+                for f in findings
+            ],
+            "new": len(new),
+            "baselined": len(accepted),
+        }
+        json.dump(payload, sys.stdout, indent=2)
+        sys.stdout.write("\n")
+        return 0
+    if not findings:
+        print("no findings")
+        return 0
+    width = max(len(f.rule) for f in findings)
+    for f in findings:
+        mark = "baselined" if baseline and baseline.accepts(f) else "NEW"
+        print(f"{f.rule:<{width}}  {mark:<9}  {f.path}:{f.line}  "
+              f"{f.message}")
+    print(f"{len(findings)} finding(s): {len(new)} new, "
+          f"{len(accepted)} baselined")
+    return 0
+
+
+def _parser(report: bool) -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="python -m distkeras_tpu.analysis"
+             + (" report" if report else ""),
+        description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument("paths", nargs="*",
+                    help="files or package dirs to scan (default: the "
+                         "installed distkeras_tpu package)")
+    ap.add_argument("--baseline", default=None,
+                    help=f"baseline file (default: {BASELINE_NAME} next "
+                         f"to the package, else ./{BASELINE_NAME})")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore any baseline file")
+    if report:
+        ap.add_argument("--json", action="store_true",
+                        help="emit findings as JSON instead of a table")
+    else:
+        ap.add_argument("--strict", action="store_true",
+                        help="exit 1 on unbaselined findings (CI mode)")
+        ap.add_argument("--write-baseline", action="store_true",
+                        help="regenerate the baseline from current "
+                             "findings (keeps existing justifications)")
+    return ap
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    report = bool(argv) and argv[0] == "report"
+    if report:
+        argv = argv[1:]
+    args = _parser(report).parse_args(argv)
+    try:
+        return report_main(args) if report else check_main(args)
+    except AnalysisError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

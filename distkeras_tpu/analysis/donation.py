@@ -1,0 +1,277 @@
+"""Donation-safety pass: a buffer passed through a donating jit call
+is dead.
+
+Every hot serving body donates its cache/logits/RNG buffers
+(``donate_argnums`` — the 18→11 ms/tick win of PR 4): after the call,
+the donated device buffer may already be aliased by the output, and
+reading the old reference is undefined behavior jax only sometimes
+warns about. The engine's convention is *rebind in the same
+statement*::
+
+    self._cache, self._last_logits, toks, self._rngs = tick(
+        self._params_only, self._cache, self._last_logits, self._rngs)
+
+This pass flags the convention's violation: a name or ``self.<attr>``
+passed in a donated position of a known-donating call and *read again
+later in the same function* without an intervening rebinding.
+
+Donating callables are discovered per module, in three shapes:
+
+1. a ``def`` decorated with ``functools.partial(jax.jit,
+   donate_argnums=...)`` / ``functools.partial(_compile, ...,
+   donate=...)`` — the engine's module-level jitted helpers;
+2. a factory whose *inner* ``def`` carries such a decorator and is
+   returned (the ``_tick_fn``-style lru-cached builders): a local
+   variable assigned from ``factory(...)`` inherits the donation
+   signature, so ``tick = _tick_fn(...); ... tick(...)`` is checked;
+3. a local variable assigned directly from ``jax.jit(f,
+   donate_argnums=...)``.
+
+Flow sensitivity is line-ordered within one function (no CFG): a
+donation inside one branch of an ``if`` and a read in the sibling
+branch can false-positive, and donations inside loops are only checked
+downstream in source order. Suppress a justified case with
+``# analysis: donation-ok``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from distkeras_tpu.analysis.core import Finding, Pass, SourceFile
+
+_DONATE_KWARGS = ("donate", "donate_argnums")
+_DONATE_NAME_KWARG = "donate_argnames"
+
+
+def _literal_positions(node: ast.AST) -> Optional[Tuple[int, ...]]:
+    """A donate spec as positions: int or tuple-of-int literals."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, ast.Tuple):
+        out = []
+        for el in node.elts:
+            if not (isinstance(el, ast.Constant)
+                    and isinstance(el.value, int)):
+                return None
+            out.append(el.value)
+        return tuple(out)
+    return None
+
+
+def _dotted(node: ast.AST) -> str:
+    """'jax.jit' for Attribute/Name chains, '' otherwise."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _donate_from_call(call: ast.Call) -> Optional[Tuple[int, ...]]:
+    """Donated positions declared by a ``jax.jit(...)`` /
+    ``functools.partial(jax.jit | _compile, ... donate*=...)`` call."""
+    callee = _dotted(call.func)
+    wraps_jit = callee in ("jax.jit", "jit")
+    if callee in ("functools.partial", "partial") and call.args:
+        inner = _dotted(call.args[0])
+        wraps_jit = inner in ("jax.jit", "jit", "_compile")
+    if not wraps_jit:
+        return None
+    for kw in call.keywords:
+        if kw.arg in _DONATE_KWARGS:
+            return _literal_positions(kw.value)
+    return None
+
+
+def _literal_names(node: ast.AST) -> Optional[Tuple[str, ...]]:
+    """A donate_argnames spec: str or tuple-of-str literals."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, ast.Tuple):
+        out = []
+        for el in node.elts:
+            if not (isinstance(el, ast.Constant)
+                    and isinstance(el.value, str)):
+                return None
+            out.append(el.value)
+        return tuple(out)
+    return None
+
+
+def _names_from_call(call: ast.Call) -> Optional[Tuple[str, ...]]:
+    callee = _dotted(call.func)
+    wraps_jit = callee in ("jax.jit", "jit")
+    if callee in ("functools.partial", "partial") and call.args:
+        wraps_jit = _dotted(call.args[0]) in ("jax.jit", "jit",
+                                              "_compile")
+    if not wraps_jit:
+        return None
+    for kw in call.keywords:
+        if kw.arg == _DONATE_NAME_KWARG:
+            return _literal_names(kw.value)
+    return None
+
+
+def _donate_from_decorators(fn) -> Optional[Tuple[int, ...]]:
+    """Donated positions from the def's decorators — donate_argnums
+    directly, donate_argnames mapped onto positions through the def's
+    own parameter list."""
+    for dec in fn.decorator_list:
+        if not isinstance(dec, ast.Call):
+            continue
+        pos = _donate_from_call(dec)
+        if pos is not None:
+            return pos
+        names = _names_from_call(dec)
+        if names is not None:
+            params = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+            mapped = tuple(params.index(n) for n in names
+                           if n in params)
+            if mapped:
+                return mapped
+    return None
+
+
+def _module_donators(tree: ast.Module):
+    """Two maps over module-level defs: ``direct`` (calling the name
+    donates) and ``factories`` (calling the name *returns* a donating
+    function — the lru-cached tick builders)."""
+    direct: Dict[str, Tuple[int, ...]] = {}
+    factories: Dict[str, Tuple[int, ...]] = {}
+    for node in tree.body:
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        pos = _donate_from_decorators(node)
+        if pos is not None:
+            direct[node.name] = pos
+            continue
+        inners = {n.name: _donate_from_decorators(n)
+                  for n in node.body
+                  if isinstance(n, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef))}
+        for stmt in ast.walk(node):
+            if (isinstance(stmt, ast.Return)
+                    and isinstance(stmt.value, ast.Name)
+                    and inners.get(stmt.value.id) is not None):
+                factories[node.name] = inners[stmt.value.id]
+    return direct, factories
+
+
+def _target_keys(target: ast.AST) -> List[str]:
+    if isinstance(target, (ast.Tuple, ast.List)):
+        out: List[str] = []
+        for el in target.elts:
+            out.extend(_target_keys(el))
+        return out
+    key = _expr_key(target)
+    return [key] if key is not None else []
+
+
+def _expr_key(node: ast.AST) -> Optional[str]:
+    """Identity of a donatable expression: 'name' or 'self.attr'."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)):
+        return f"{node.value.id}.{node.attr}"
+    return None
+
+
+class DonationSafetyPass(Pass):
+    rule = "donation-safety"
+    suppression = "donation-ok"
+
+    def run(self, src: SourceFile) -> Iterator[Finding]:
+        direct, factories = _module_donators(src.tree)
+        for node in ast.walk(src.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from self._check_function(src, node, direct,
+                                                factories)
+
+    def _check_function(self, src: SourceFile, fn,
+                        direct: Dict[str, Tuple[int, ...]],
+                        factories: Dict[str, Tuple[int, ...]],
+                        ) -> Iterator[Finding]:
+        # donating callables visible in this function: module-level
+        # decorated defs, plus locals bound from a factory call or a
+        # direct jax.jit(..., donate_argnums=...) call
+        donating = dict(direct)
+        for stmt in ast.walk(fn):
+            if (isinstance(stmt, ast.Assign)
+                    and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)
+                    and isinstance(stmt.value, ast.Call)):
+                name = stmt.targets[0].id
+                callee = _dotted(stmt.value.func)
+                if callee in factories:
+                    donating[name] = factories[callee]
+                else:
+                    pos = _donate_from_call(stmt.value)
+                    if pos is not None:
+                        donating[name] = pos
+
+        # donation events: key -> line after which the old binding is
+        # dead (end of the donating statement; same-statement rebinds
+        # are exempt by construction)
+        dead: Dict[str, int] = {}
+        for stmt in ast.walk(fn):
+            if (isinstance(stmt, ast.Assign)
+                    and isinstance(stmt.value, ast.Call)):
+                call, rebound = stmt.value, set()
+                for t in stmt.targets:
+                    rebound.update(_target_keys(t))
+            elif (isinstance(stmt, ast.Expr)
+                    and isinstance(stmt.value, ast.Call)):
+                call, rebound = stmt.value, set()
+            else:
+                continue
+            positions = donating.get(_dotted(call.func))
+            if positions is None:
+                continue
+            for i in positions:
+                if i >= len(call.args):
+                    continue
+                akey = _expr_key(call.args[i])
+                if akey is not None and akey not in rebound:
+                    end = getattr(stmt, "end_lineno", stmt.lineno)
+                    prev = dead.get(akey)
+                    dead[akey] = end if prev is None else min(prev, end)
+
+        if not dead:
+            return
+        stores: Dict[str, List[int]] = {}
+        loads: Dict[str, List[int]] = {}
+        for node in ast.walk(fn):
+            key = _expr_key(node)
+            if key is None or key not in dead:
+                continue
+            ctx = getattr(node, "ctx", None)
+            if isinstance(ctx, ast.Store):
+                stores.setdefault(key, []).append(node.lineno)
+            elif isinstance(ctx, ast.Load):
+                loads.setdefault(key, []).append(node.lineno)
+
+        for key, line in sorted(dead.items()):
+            rebinds = [ln for ln in stores.get(key, []) if ln > line]
+            next_rebind = min(rebinds) if rebinds else None
+            for load_line in sorted(loads.get(key, [])):
+                if load_line <= line:
+                    continue
+                if next_rebind is not None and load_line >= next_rebind:
+                    break
+                yield Finding(
+                    rule=self.rule, path=src.rel, line=load_line,
+                    key=f"{fn.name}.{key}",
+                    message=(
+                        f"{key} is read after being donated to a "
+                        f"jitted call at line {line} in {fn.name}() — "
+                        f"donated buffers may alias the output; rebind "
+                        f"before reuse"
+                    ),
+                )
+                break  # one finding per donated key is enough

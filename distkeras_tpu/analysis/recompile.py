@@ -1,0 +1,207 @@
+"""Recompile-hazard pass: compile-cache keys must be hashable and
+stable.
+
+The serving engine compiles once per configuration because everything
+on a compile-cache key path is hashable and value-stable: the
+lru-cached tick builders (``_tick_fn``/``_mixed_tick_fn``/...) key on
+(module, cfgs tuple, chunk, ``_ShardCtx``), and ``_ShardCtx`` freezes
+its spec pytrees into tuples for exactly this reason. Two failure
+shapes sneak past review:
+
+- an **unhashable** object (list, dict, set, lambda) reaching an
+  ``lru_cache`` key or a jit ``static_argnums`` position —
+  ``TypeError`` at best, and with ``default=`` tricks a silent cache
+  bypass;
+- a **freshly-constructed** object (an f-string, a comprehension, a
+  lambda) built at the call site — hashable or not, it defeats caches
+  keyed on identity and forces a retrace per call when it lands in a
+  jit static argument.
+
+This pass flags literal lists/dicts/sets/comprehensions/lambdas/
+f-strings (and locals last assigned from one) in:
+
+1. arguments of calls to module functions decorated with
+   ``functools.lru_cache`` (the tick/prefill builders);
+2. arguments of calls to *cache-key constructors* — ``_ShardCtx`` and
+   ``_compile`` by default (configurable), the engine's hashable
+   shard-context contract;
+3. jit ``static_argnums`` positions: calls through a local bound to
+   ``jax.jit(f, static_argnums=...)`` or to a def decorated with
+   ``functools.partial(jax.jit, static_argnums=...)``.
+
+Suppress a justified case with ``# analysis: recompile-ok``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from distkeras_tpu.analysis.core import Finding, Pass, SourceFile
+
+# expression node types that are unhashable or freshly constructed
+_HAZARD_NODES = (
+    ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp, ast.SetComp,
+    ast.GeneratorExp, ast.Lambda, ast.JoinedStr,
+)
+
+_HAZARD_NAMES = {
+    ast.List: "list literal", ast.Dict: "dict literal",
+    ast.Set: "set literal", ast.ListComp: "list comprehension",
+    ast.DictComp: "dict comprehension", ast.SetComp: "set comprehension",
+    ast.GeneratorExp: "generator expression", ast.Lambda: "lambda",
+    ast.JoinedStr: "f-string (fresh per call)",
+}
+
+
+def _dotted(node: ast.AST) -> str:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _is_lru_decorated(fn) -> bool:
+    for dec in fn.decorator_list:
+        d = dec.func if isinstance(dec, ast.Call) else dec
+        if _dotted(d) in ("functools.lru_cache", "lru_cache",
+                          "functools.cache", "cache"):
+            return True
+    return False
+
+
+def _static_positions(call: ast.Call) -> Optional[Tuple[int, ...]]:
+    """static_argnums positions from a jax.jit(...) or
+    functools.partial(jax.jit, ...) call expression."""
+    callee = _dotted(call.func)
+    is_jit = callee in ("jax.jit", "jit")
+    if callee in ("functools.partial", "partial") and call.args:
+        is_jit = _dotted(call.args[0]) in ("jax.jit", "jit")
+    if not is_jit:
+        return None
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            node = kw.value
+            if (isinstance(node, ast.Constant)
+                    and isinstance(node.value, int)):
+                return (node.value,)
+            if isinstance(node, ast.Tuple):
+                out = []
+                for el in node.elts:
+                    if not (isinstance(el, ast.Constant)
+                            and isinstance(el.value, int)):
+                        return None
+                    out.append(el.value)
+                return tuple(out)
+    return None
+
+
+def _hazard(node: ast.AST,
+            local_hazards: Dict[str, str]) -> Optional[str]:
+    """Why this argument expression is a cache-key hazard, or None.
+    Tuples are checked recursively (a tuple of lists is as unhashable
+    as the list)."""
+    if isinstance(node, _HAZARD_NODES):
+        return _HAZARD_NAMES[type(node)]
+    if isinstance(node, ast.Name) and node.id in local_hazards:
+        return f"variable holding a {local_hazards[node.id]}"
+    if isinstance(node, ast.Tuple):
+        for el in node.elts:
+            why = _hazard(el, local_hazards)
+            if why:
+                return f"tuple containing a {why}"
+    return None
+
+
+class RecompileHazardPass(Pass):
+    rule = "recompile-hazard"
+    suppression = "recompile-ok"
+
+    def __init__(self, key_constructors: Tuple[str, ...] = (
+            "_ShardCtx", "_compile")):
+        self.key_constructors = set(key_constructors)
+
+    def run(self, src: SourceFile) -> Iterator[Finding]:
+        lru_fns: Set[str] = set()
+        static_fns: Dict[str, Tuple[int, ...]] = {}
+        for node in ast.walk(src.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if _is_lru_decorated(node):
+                    lru_fns.add(node.name)
+                for dec in node.decorator_list:
+                    if isinstance(dec, ast.Call):
+                        pos = _static_positions(dec)
+                        if pos is not None:
+                            static_fns[node.name] = pos
+        for node in ast.walk(src.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from self._check_function(
+                    src, node, lru_fns, static_fns)
+
+    def _check_function(self, src: SourceFile, fn, lru_fns: Set[str],
+                        module_static: Dict[str, Tuple[int, ...]],
+                        ) -> Iterator[Finding]:
+        static_fns = dict(module_static)
+        local_hazards: Dict[str, str] = {}
+        for stmt in ast.walk(fn):
+            if not (isinstance(stmt, ast.Assign)
+                    and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)):
+                continue
+            name = stmt.targets[0].id
+            if isinstance(stmt.value, ast.Call):
+                pos = _static_positions(stmt.value)
+                if pos is not None:
+                    static_fns[name] = pos
+                    continue
+            why = None
+            if isinstance(stmt.value, _HAZARD_NODES):
+                why = _HAZARD_NAMES[type(stmt.value)]
+            if why:
+                local_hazards[name] = why
+            else:
+                local_hazards.pop(name, None)
+
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = _dotted(node.func)
+            short = callee.split(".")[-1]
+            if short in lru_fns or short in self.key_constructors:
+                checked = list(enumerate(node.args)) + [
+                    (kw.arg, kw.value) for kw in node.keywords]
+                for where, arg in checked:
+                    why = _hazard(arg, local_hazards)
+                    if why:
+                        yield Finding(
+                            rule=self.rule, path=src.rel,
+                            line=arg.lineno,
+                            key=f"{fn.name}.{short}",
+                            message=(
+                                f"{why} flows into cache-keyed call "
+                                f"{short}() (arg {where}) in "
+                                f"{fn.name}() — compile-cache keys "
+                                f"must be hashable and value-stable"
+                            ),
+                        )
+            positions = static_fns.get(short)
+            if positions:
+                for i in positions:
+                    if i < len(node.args):
+                        why = _hazard(node.args[i], local_hazards)
+                        if why:
+                            yield Finding(
+                                rule=self.rule, path=src.rel,
+                                line=node.args[i].lineno,
+                                key=f"{fn.name}.{short}",
+                                message=(
+                                    f"{why} flows into static_argnums "
+                                    f"position {i} of jitted {short}() "
+                                    f"in {fn.name}() — every call "
+                                    f"retraces (or TypeErrors)"
+                                ),
+                            )

@@ -1,0 +1,205 @@
+"""RNG-discipline pass: a PRNG key is consumed exactly once.
+
+The serving engine's bit-parity guarantee (engine streams ==
+solo ``generate()`` streams) hangs on one rule: every ``jax.random``
+key is consumed by exactly one sampling/split site and then never
+touched again — a slot's chain advances once per *emitted* token, with
+``split`` producing the next link. Reusing a key correlates draws
+(silently — nothing crashes); the parity tests catch it eventually,
+this pass catches it at review time.
+
+Mechanics, per function body:
+
+- **key variables**: names assigned from ``jax.random.PRNGKey``,
+  ``jax.random.split``, ``jax.random.fold_in`` (tuple-unpack targets of
+  ``split`` are all keys), names copied from another key variable, and
+  function parameters whose name says key (``rng``, ``key``,
+  ``*_rng``, ``*_key``).
+- **consumption sites**: a key passed to any ``jax.random.*`` call
+  except ``PRNGKey`` (``split``, ``categorical``, ``uniform``, ...),
+  or to a known sampler (``sample_tokens``) — key-*deriving* calls
+  consume their operand too (``split(k)`` spends ``k``).
+- **violation**: the same key variable consumed twice with no
+  reassignment between the two sites in program order, where both
+  sites can execute in one pass (consumptions in sibling
+  ``if``/``else`` arms are alternatives, not repeats).
+
+Events are ordered by statement, with a statement's RHS consumption
+sequenced *before* its target binding — so the canonical
+``rng, sub = jax.random.split(rng)`` chain never trips the rule, while
+``u = uniform(rng); rng, _ = split(rng)`` (consume, then consume again
+before the rebind lands) does. Suppress a justified reuse (none should
+exist) with ``# analysis: rng-ok``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from distkeras_tpu.analysis.core import Finding, Pass, SourceFile
+
+_KEY_MAKERS = {"PRNGKey", "split", "fold_in"}
+# non-jax.random callables whose key argument is consumed
+_EXTRA_CONSUMERS = {"sample_tokens"}
+
+
+def _dotted(node: ast.AST) -> str:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _is_jax_random(callee: str) -> Optional[str]:
+    """The jax.random function name, for 'jax.random.split' /
+    'random.split' / 'jrandom.split' spellings; None otherwise."""
+    parts = callee.split(".")
+    if len(parts) >= 2 and parts[-2] in ("random", "jrandom", "jrng"):
+        return parts[-1]
+    return None
+
+
+class _FnScanner(ast.NodeVisitor):
+    """Collect, for one function body: key-variable rebinding events and
+    key-consumption events, ordered by (statement index, phase) with
+    consumption phase 0 < binding phase 1 — RHS evaluates before targets
+    bind — and tagged with a branch signature (the chain of (if, arm)
+    ancestors) so sibling-arm consumptions read as alternatives."""
+
+    def __init__(self):
+        self.keyvars: Set[str] = set()
+        # var -> [order]: rebinding events
+        self.assigns: Dict[str, List[Tuple[int, int]]] = {}
+        # (var, order, line, branch-signature)
+        self.consumes: List[Tuple[str, Tuple[int, int], int, Tuple]] = []
+        self._branch: Tuple = ()
+        self._stmt_idx = 0
+        self._cur = 0
+
+    def visit(self, node):
+        if isinstance(node, ast.stmt):
+            self._stmt_idx += 1
+            self._cur = self._stmt_idx
+        return super().visit(node)
+
+    # -- branch tracking -----------------------------------------------------
+
+    def visit_If(self, node: ast.If):
+        self.visit(node.test)
+        saved = self._branch
+        self._branch = saved + ((id(node), "body"),)
+        for stmt in node.body:
+            self.visit(stmt)
+        self._branch = saved + ((id(node), "orelse"),)
+        for stmt in node.orelse:
+            self.visit(stmt)
+        self._branch = saved
+
+    def visit_FunctionDef(self, node):
+        return  # nested defs are scanned as their own functions
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+
+    # -- assignments ---------------------------------------------------------
+
+    def visit_Assign(self, node: ast.Assign):
+        self.visit(node.value)  # consumption first (RHS order)
+        is_key = self._is_key_expr(node.value)
+        for t in node.targets:
+            names = ([t] if isinstance(t, ast.Name)
+                     else [el for el in getattr(t, "elts", [])
+                           if isinstance(el, ast.Name)])
+            for el in names:
+                self.assigns.setdefault(el.id, []).append((self._cur, 1))
+                if is_key:
+                    self.keyvars.add(el.id)
+
+    def _is_key_expr(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Call):
+            name = _is_jax_random(_dotted(node.func))
+            return name in _KEY_MAKERS
+        if isinstance(node, ast.Name):
+            return node.id in self.keyvars
+        return False
+
+    # -- consumption ---------------------------------------------------------
+
+    def visit_Call(self, node: ast.Call):
+        callee = _dotted(node.func)
+        jr = _is_jax_random(callee)
+        consumer = (jr is not None and jr != "PRNGKey") \
+            or callee.split(".")[-1] in _EXTRA_CONSUMERS
+        if consumer:
+            args = list(node.args) + [
+                kw.value for kw in node.keywords
+                if kw.arg in ("rng", "key", "rngs")
+            ]
+            for arg in args:
+                if isinstance(arg, ast.Name) and arg.id in self.keyvars:
+                    self.consumes.append(
+                        (arg.id, (self._cur, 0), arg.lineno,
+                         self._branch))
+        self.generic_visit(node)
+
+
+def _compatible(a: Tuple, b: Tuple) -> bool:
+    """Two branch signatures can both execute in one pass unless they
+    take different arms at a shared ``if``."""
+    arms_a = dict(a)
+    for if_id, arm in b:
+        if if_id in arms_a and arms_a[if_id] != arm:
+            return False
+    return True
+
+
+class RngDisciplinePass(Pass):
+    rule = "rng-discipline"
+    suppression = "rng-ok"
+
+    def run(self, src: SourceFile) -> Iterator[Finding]:
+        for node in ast.walk(src.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from self._check_function(src, node)
+
+    def _check_function(self, src: SourceFile, fn) -> Iterator[Finding]:
+        sc = _FnScanner()
+        args = fn.args
+        for a in (args.posonlyargs + args.args + args.kwonlyargs
+                  + [x for x in (args.vararg, args.kwarg) if x]):
+            low = a.arg.lower()
+            if low in ("rng", "key") or low.endswith(("_rng", "_key")):
+                sc.keyvars.add(a.arg)
+        for stmt in fn.body:
+            sc.visit(stmt)
+        if not sc.consumes:
+            return
+        by_var: Dict[str, List[Tuple[Tuple[int, int], int, Tuple]]] = {}
+        for var, order, line, branch in sc.consumes:
+            by_var.setdefault(var, []).append((order, line, branch))
+        for var, events in sorted(by_var.items()):
+            if len(events) < 2:
+                continue
+            events.sort()
+            assigns = sorted(sc.assigns.get(var, []))
+            for (o1, l1, b1), (o2, l2, b2) in zip(events, events[1:]):
+                if not _compatible(b1, b2):
+                    continue  # sibling arms: alternatives, not reuse
+                if any(o1 < a < o2 for a in assigns):
+                    continue  # rebound between the two consumptions
+                yield Finding(
+                    rule=self.rule, path=src.rel, line=l2,
+                    key=f"{fn.name}.{var}",
+                    message=(
+                        f"PRNG key {var!r} is consumed again at line "
+                        f"{l2} after already being consumed at line "
+                        f"{l1} in {fn.name}() with no reassignment "
+                        f"between — key reuse correlates draws"
+                    ),
+                )
+                break  # one finding per key variable is enough

@@ -104,9 +104,13 @@ class Replica:
             client.close()
 
     def snapshot(self) -> Dict:
-        """Plain-data view for the aggregated stats op."""
-        return {"state": self.state, "host": self.host, "port": self.port,
-                **({"stats": self.last_stats} if self.last_stats else {})}
+        """Plain-data view for the aggregated stats op. ``state`` and
+        ``last_stats`` move under the probe thread's hands; read them
+        under the lock so one snapshot is internally consistent."""
+        with self._lock:
+            state, stats = self.state, self.last_stats
+        return {"state": state, "host": self.host, "port": self.port,
+                **({"stats": stats} if stats else {})}
 
 
 def merge_metric_snapshots(snapshots: Sequence[Dict[str, dict]],
@@ -269,17 +273,21 @@ class ReplicaManager:
             stats = client._call({"op": "stats"},
                                  timeout=self.probe_timeout)["stats"]
         except Exception:
-            r.failures += 1
-            if r.state == DOWN or r.failures >= self.down_after:
-                self._down(r)
-            else:
-                r.state = SUSPECT
+            with r._lock:
+                r.failures += 1
+                go_down = (r.state == DOWN
+                           or r.failures >= self.down_after)
+                if not go_down:
+                    r.state = SUSPECT
+            if go_down:
+                self._down(r)  # takes the replica lock itself
             self._m_up.labels(replica=r.name).set(0)
             return
-        r.failures = 0
-        r.backoff_s = 0.0
-        r.last_stats = dict(stats)
-        r.state = DRAINING if stats.get("draining") else HEALTHY
+        with r._lock:
+            r.failures = 0
+            r.backoff_s = 0.0
+            r.last_stats = dict(stats)
+            r.state = DRAINING if stats.get("draining") else HEALTHY
         self._m_up.labels(replica=r.name).set(1)
         self._m_depth.labels(replica=r.name).set(
             stats.get("queue_depth", 0))

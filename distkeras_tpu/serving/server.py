@@ -387,11 +387,14 @@ class ServingClient:
     @property
     def closed(self) -> bool:
         """True once the connection is gone (locally closed or died)."""
-        return self._closed
+        # a stale False only means the caller raced the close, which
+        # every locked read would too — monotonic-flag monitor read
+        return self._closed  # analysis: unguarded-ok
 
     @property
     def close_reason(self) -> Optional[str]:
         """Why the connection ended (None while it is alive)."""
+        # analysis: unguarded-ok (monitor read; set once at close)
         return self._close_reason
 
     def _stream_q(self, rid: int) -> _queue.Queue:
@@ -606,8 +609,12 @@ class ServingClient:
         """Idempotent: safe to call twice, or after the connection
         already died (socket close is a no-op then). Shutdown-first so
         the reader thread unblocks and seeds every pending stream with
-        its terminal frame."""
-        if not self._closed:
-            self._close_reason = "closed by client"
-            self._closed = True
+        its terminal frame. The closed flags flip under the streams
+        lock — the same discipline as the reader's shutdown sweep —
+        so ``_stream_q`` can never create a queue against a
+        half-closed connection that misses its terminal seed."""
+        with self._streams_lock:
+            if not self._closed:
+                self._close_reason = "closed by client"
+                self._closed = True
         shutdown_close(self._sock)

@@ -99,11 +99,15 @@ class FlightRecorder:
     # -- dumping ------------------------------------------------------------
 
     def meta(self, reason: str = "scrape", **attrs) -> dict:
-        """The dump header record: reason, pid, ring occupancy."""
+        """The dump header record: reason, pid, ring occupancy.
+        ``recorded`` and ``dropped`` are read under one lock hold so a
+        concurrent ``record()`` can't skew them against each other."""
+        with self._lock:
+            recorded, dropped = len(self._buf), self.dropped
         meta = {
             "kind": "flight_meta", "reason": reason, "pid": os.getpid(),
             "unix_time": round(time.time(), 3),
-            "recorded": len(self), "dropped": self.dropped,
+            "recorded": recorded, "dropped": dropped,
         }
         for k, v in attrs.items():
             if v is not None:

@@ -53,7 +53,10 @@ class MetricsWriter:
 
     @property
     def records(self) -> List[dict]:
-        return list(self._records)
+        # under the lock like every other _records access: a list copy
+        # concurrent with an append must not observe a half-built state
+        with self._lock:
+            return list(self._records)
 
     def percentiles(
         self, key: str, ps=(50, 90, 99)

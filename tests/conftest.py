@@ -26,6 +26,34 @@ jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
+# Suites that exercise the cross-thread serving surfaces run under the
+# dynamic lock-order detector (distkeras_tpu.analysis.lockorder): every
+# threading.Lock/RLock allocated from package or test code during the
+# test reports its acquisition order, and a cycle in the global graph —
+# a lock-order inversion, i.e. a deadlock awaiting its interleaving —
+# fails the test even though no deadlock happened. Off everywhere else:
+# nothing is installed, threading is untouched, overhead is zero.
+_LOCKORDER_SUITES = {"test_serving", "test_router", "test_telemetry"}
+
+
+@pytest.fixture(autouse=True)
+def _lock_order_guard(request):
+    name = request.module.__name__.rpartition(".")[2]
+    if name not in _LOCKORDER_SUITES:
+        yield
+        return
+    from distkeras_tpu.analysis.lockorder import LockOrderDetector
+
+    det = LockOrderDetector()
+    det.install()
+    try:
+        yield det
+    finally:
+        det.uninstall()
+    # only reached when the test body didn't raise: report inversions
+    # without masking a genuine test failure
+    det.assert_no_cycles()
+
 
 @pytest.fixture(scope="session")
 def devices():

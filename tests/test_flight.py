@@ -508,3 +508,44 @@ def test_report_flight_rejects_trace_jsonl(tmp_path):
     with pytest.raises(SystemExit) as ei:
         telemetry_report.main(["--flight", str(path)])
     assert ei.value.code == 2
+
+
+def test_meta_counts_read_under_one_lock_hold(tmp_path):
+    """Regression (lock-discipline fix): meta() snapshots recorded and
+    dropped under ONE lock hold. A deterministic torn-read probe: the
+    ring holds 2 of 4 snapshots; the probe lock injects 3 more records
+    the moment meta() first releases the lock. A consistent snapshot is
+    (2, 0) [before the injection] or (4, 1) [after]; the pre-fix code
+    (locked len(), then an unlocked `self.dropped` read) returns the
+    impossible (2, 1)."""
+    fl = FlightRecorder(capacity=4, postmortem_dir=str(tmp_path))
+    fl.record({"kind": "tick", "tick": 0})
+    fl.record({"kind": "tick", "tick": 1})
+
+    real = fl._lock
+
+    class ProbeLock:
+        def __init__(self):
+            self.injected = False
+
+        def __enter__(self):
+            return real.__enter__()
+
+        def __exit__(self, *exc):
+            out = real.__exit__(*exc)
+            if not self.injected:
+                self.injected = True
+                fl._lock = real  # the injection records normally
+                for i in range(3):
+                    fl.record({"kind": "tick", "tick": 2 + i})
+                fl._lock = self
+            return out
+
+    fl._lock = ProbeLock()
+    try:
+        m = fl.meta("scrape")
+    finally:
+        fl._lock = real
+    assert (m["recorded"], m["dropped"]) in ((2, 0), (4, 1)), (
+        f"torn recorded/dropped pair: {m['recorded']}, {m['dropped']}"
+    )

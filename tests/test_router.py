@@ -28,6 +28,7 @@ from distkeras_tpu.serving import (
     ServingEngine,
     merge_metric_snapshots,
 )
+from distkeras_tpu.serving.fleet import Replica
 from distkeras_tpu.serving.router import PrefixAffinityIndex, _HashRing
 
 # identical to test_serving/test_paged KW, so every slot-engine tick
@@ -619,3 +620,31 @@ def test_router_rejects_unknown_policy_and_bad_replica(model_and_params):
             client.flight()
     finally:
         _stop(servers, router, [client])
+
+
+def test_replica_snapshot_reads_state_under_lock():
+    """Regression (lock-discipline fix): snapshot() reads state and
+    last_stats under the replica lock, so the probe thread's updates
+    can't tear one snapshot across two states."""
+    r = Replica("127.0.0.1", 1, name="r0")
+    r.state = "healthy"
+    r.last_stats = {"queue_depth": 3}
+    real = r._lock
+    acquired = []
+
+    class ProbeLock:
+        def __enter__(self):
+            acquired.append(True)
+            return real.__enter__()
+
+        def __exit__(self, *exc):
+            return real.__exit__(*exc)
+
+    r._lock = ProbeLock()
+    try:
+        snap = r.snapshot()
+    finally:
+        r._lock = real
+    assert acquired, "snapshot() must read state/last_stats under _lock"
+    assert snap["state"] == "healthy"
+    assert snap["stats"] == {"queue_depth": 3}

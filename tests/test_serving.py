@@ -382,3 +382,54 @@ def test_server_rejects_bad_requests():
         client.close()
     finally:
         server.stop()
+
+
+def test_client_close_flips_flags_under_streams_lock():
+    """Regression (lock-discipline fix): close() must mark the
+    connection closed under _streams_lock — the same discipline as the
+    reader thread's shutdown sweep — so _stream_q can never race a
+    half-closed connection. Asserted via a counting probe lock."""
+    model, params = _model_and_params()
+    eng = ServingEngine(model, params, slots=1)
+    server = LMServer(eng).start()
+    try:
+        client = ServingClient("127.0.0.1", server.port)
+        real = client._streams_lock
+        acquired = []
+
+        class ProbeLock:
+            def __enter__(self):
+                acquired.append(True)
+                return real.__enter__()
+
+            def __exit__(self, *exc):
+                return real.__exit__(*exc)
+
+        client._streams_lock = ProbeLock()
+        try:
+            client.close()
+        finally:
+            client._streams_lock = real
+        assert acquired, "close() must flip _closed under _streams_lock"
+        assert client.closed and client.close_reason == "closed by client"
+        client.close()  # still idempotent through the locked path
+    finally:
+        server.stop()
+
+
+def test_lockorder_detector_is_armed_in_this_suite():
+    """Meta-test: the conftest fixture must actually install the
+    lock-order detector for this module (and engines/clients built
+    here allocate tracked locks), otherwise the suite's 'no cycle'
+    guarantee is vacuous."""
+    import threading as _threading
+
+    from distkeras_tpu.analysis import lockorder as _lo
+
+    assert _threading.Lock is not _lo._REAL_LOCK, (
+        "conftest _lock_order_guard did not install the detector"
+    )
+    probe = _threading.Lock()  # allocated from tests/: tracked
+    assert type(probe).__name__ == "_TrackedLock"
+    with probe:
+        pass

@@ -19,6 +19,7 @@ import pytest
 
 from distkeras_tpu import telemetry
 from distkeras_tpu.telemetry import report as telemetry_report
+from distkeras_tpu.utils.metrics import MetricsWriter
 
 KW = dict(vocab_size=64, d_model=32, num_heads=2, num_layers=2,
           max_len=48, dtype=jnp.float32, attention="dense")
@@ -538,3 +539,30 @@ def test_prometheus_scrape_concurrent_with_writes():
         for t in threads:
             t.join()
     assert not errors
+
+
+def test_metrics_writer_records_snapshot_takes_the_lock():
+    """Regression (lock-discipline fix): the .records property copies
+    the list under the writer's lock like every other _records access
+    — asserted directly via a counting probe lock, since a GIL-masked
+    race is not reliably observable from outside."""
+    w = MetricsWriter()
+    w.log(step=1, loss=0.5)
+    real = w._lock
+    acquired = []
+
+    class ProbeLock:
+        def __enter__(self):
+            acquired.append(True)
+            return real.__enter__()
+
+        def __exit__(self, *exc):
+            return real.__exit__(*exc)
+
+    w._lock = ProbeLock()
+    try:
+        recs = w.records
+    finally:
+        w._lock = real
+    assert len(recs) == 1 and recs[0]["loss"] == 0.5
+    assert acquired, ".records must snapshot under the writer lock"
