@@ -315,7 +315,44 @@ def main():
     out.update(serve_interference_bench())
     out.update(serve_speculative_bench())
     out.update(serve_router_bench())
+    out.update(serve_pipeline_bench())
     print(json.dumps(out))
+
+
+def serve_pipeline_bench():
+    """Pipelined-engine-loop numbers for the BENCH trajectory: decode
+    tok/s of ServingEngine(pipeline=True) vs the sync reference, the
+    flight-recorder device-wait p50s, and whether this runtime is
+    readback-bound (where the overlap win is expressible). Self-asserts
+    are off (``checks=False``) and errors are folded into the JSON,
+    same policy as the other serving lines."""
+    import os
+    import sys
+
+    sys.path.insert(
+        0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "benchmarks"))
+    try:
+        import serve_bench
+
+        r = serve_bench.bench_pipeline(smoke=True, checks=False)
+        return {
+            "serve_pipe_speedup": r["speedup"],
+            "serve_pipe_tokens_per_sec": r["pipe_tokens_per_sec"],
+            "serve_pipe_sync_tokens_per_sec": r["sync_tokens_per_sec"],
+            "serve_pipe_paged_tokens_per_sec":
+                r["paged_pipe_tokens_per_sec"],
+            "serve_pipe_device_wait_ms_p50":
+                r["pipe_device_wait_ms_p50"],
+            "serve_pipe_sync_device_wait_ms_p50":
+                r["sync_device_wait_ms_p50"],
+            "serve_pipe_overrun_tokens": r["overrun_tokens"],
+            "serve_pipe_overlap_capable": r["overlap_capable"],
+            "serve_pipe_parity": r["parity"],
+            "serve_pipe_config": r["config"],
+        }
+    except Exception as e:  # pragma: no cover - accelerator-dependent
+        return {"serve_pipe_error": f"{type(e).__name__}: {e}"}
 
 
 def serve_interference_bench():

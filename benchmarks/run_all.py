@@ -424,9 +424,35 @@ def config12():
     }))
 
 
+def config13():
+    """Pipelined async engine loop: ServingEngine(pipeline=True) vs the
+    sync reference (benchmarks/serve_bench.py --pipeline; the --smoke
+    variant self-asserts bit-parity across slot+paged, zero
+    steady-state recompiles, bounded flight overhead, and the >=1.15x
+    overlap speedup wherever the runtime is readback-bound)."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import serve_bench
+
+    out = serve_bench.bench_pipeline(smoke=True)
+    print(json.dumps({
+        "config": 13, "metric": "serving_pipeline_speedup",
+        "value": out["speedup"],
+        "unit": "x (pipelined decode tok/s / sync)",
+        "pipe_tokens_per_sec": out["pipe_tokens_per_sec"],
+        "sync_tokens_per_sec": out["sync_tokens_per_sec"],
+        "pipe_device_wait_ms_p50": out["pipe_device_wait_ms_p50"],
+        "sync_device_wait_ms_p50": out["sync_device_wait_ms_p50"],
+        "overrun_tokens": out["overrun_tokens"],
+        "overlap_capable": out["overlap_capable"],
+        "parity": out["parity"],
+        "model": out["config"],
+        "data": "synthetic-staggered-mixed-sampling-drain",
+    }))
+
+
 CONFIGS = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5,
            6: config6, 7: config7, 8: config8, 9: config9, 10: config10,
-           11: config11, 12: config12}
+           11: config11, 12: config12, 13: config13}
 
 
 def main():

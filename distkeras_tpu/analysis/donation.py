@@ -27,6 +27,17 @@ Donating callables are discovered per module, in three shapes:
 3. a local variable assigned directly from ``jax.jit(f,
    donate_argnums=...)``.
 
+The pipelined engine loop adds a second hazard this pass covers: the
+**in-flight handoff**. A dispatched-but-unread tick parks its record on
+``self._pending`` and is reconciled one step later — so any donated
+buffer captured into such a record would be read after a LATER call
+donated it, from a different ``step()`` invocation where line-order
+flow analysis cannot see it. The rule: a donated key loaded into the
+arguments of a non-donating call *before* the donation, whose result
+is assigned to a local that later escapes to ``self`` (attribute
+assignment or ``self.<attr>.append``), is flagged — in-flight records
+may hold tick *outputs* only, never the donated inputs.
+
 Flow sensitivity is line-ordered within one function (no CFG): a
 donation inside one branch of an ``if`` and a read in the sibling
 branch can false-positive, and donations inside loops are only checked
@@ -215,10 +226,14 @@ class DonationSafetyPass(Pass):
                     if pos is not None:
                         donating[name] = pos
 
-        # donation events: key -> line after which the old binding is
-        # dead (end of the donating statement; same-statement rebinds
-        # are exempt by construction)
+        # donation events: ``dead`` maps key -> line after which the
+        # old binding is dead (end of the donating statement;
+        # same-statement rebinds are exempt by construction).
+        # ``donated_all`` records EVERY donated key — rebound or not —
+        # for the handoff rule: a pre-donation capture into an escaping
+        # record holds the OLD buffer even when the call itself rebinds
         dead: Dict[str, int] = {}
+        donated_all: Dict[str, int] = {}
         for stmt in ast.walk(fn):
             if (isinstance(stmt, ast.Assign)
                     and isinstance(stmt.value, ast.Call)):
@@ -237,11 +252,19 @@ class DonationSafetyPass(Pass):
                 if i >= len(call.args):
                     continue
                 akey = _expr_key(call.args[i])
-                if akey is not None and akey not in rebound:
-                    end = getattr(stmt, "end_lineno", stmt.lineno)
+                if akey is None:
+                    continue
+                end = getattr(stmt, "end_lineno", stmt.lineno)
+                prev_any = donated_all.get(akey)
+                donated_all[akey] = (end if prev_any is None
+                                     else min(prev_any, end))
+                if akey not in rebound:
                     prev = dead.get(akey)
                     dead[akey] = end if prev is None else min(prev, end)
 
+        if donated_all:
+            yield from self._check_handoff_escape(src, fn, donating,
+                                                  donated_all)
         if not dead:
             return
         stores: Dict[str, List[int]] = {}
@@ -275,3 +298,72 @@ class DonationSafetyPass(Pass):
                     ),
                 )
                 break  # one finding per donated key is enough
+
+    def _check_handoff_escape(self, src: SourceFile, fn,
+                              donating: Dict[str, Tuple[int, ...]],
+                              donated: Dict[str, int],
+                              ) -> Iterator[Finding]:
+        """The in-flight handoff rule: a donated key captured (as a
+        call argument, positional or keyword) into a value that escapes
+        to ``self`` — ``self.x = rec`` or ``self.x.append(rec)`` —
+        BEFORE the donation line. The record outlives the function (the
+        pipelined engine reconciles it a step later), so the parked
+        reference is read after a donation that line-order analysis in
+        the reader's frame can never see. Records must hold tick
+        outputs only."""
+        # locals that escape to self anywhere in this function
+        escaping: Dict[str, int] = {}
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if (isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"
+                            and isinstance(node.value, ast.Name)):
+                        escaping[node.value.id] = node.lineno
+            elif (isinstance(node, ast.Expr)
+                    and isinstance(node.value, ast.Call)
+                    and isinstance(node.value.func, ast.Attribute)
+                    and node.value.func.attr in ("append", "appendleft",
+                                                 "add", "push")):
+                recv = node.value.func.value
+                if (isinstance(recv, ast.Attribute)
+                        and isinstance(recv.value, ast.Name)
+                        and recv.value.id == "self"):
+                    for a in node.value.args:
+                        if isinstance(a, ast.Name):
+                            escaping[a.id] = node.lineno
+        if not escaping:
+            return
+        for stmt in ast.walk(fn):
+            if not (isinstance(stmt, ast.Assign)
+                    and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)
+                    and isinstance(stmt.value, ast.Call)):
+                continue
+            target = stmt.targets[0].id
+            if target not in escaping:
+                continue
+            call = stmt.value
+            if _dotted(call.func) in donating:
+                continue  # the donating call itself is the rebind site
+            captured = list(call.args) + [kw.value for kw in
+                                          call.keywords]
+            for arg in captured:
+                for node in ast.walk(arg):
+                    key = _expr_key(node)
+                    if key is None or key not in donated:
+                        continue
+                    if stmt.lineno > donated[key]:
+                        continue  # post-donation reads: main rule's job
+                    yield Finding(
+                        rule=self.rule, path=src.rel, line=stmt.lineno,
+                        key=f"{fn.name}.{key}:handoff",
+                        message=(
+                            f"{key} is captured into '{target}' (which "
+                            f"escapes to self) before being donated at "
+                            f"line {donated[key]} in {fn.name}() — "
+                            f"in-flight records must hold tick outputs, "
+                            f"never the donated inputs"
+                        ),
+                    )

@@ -36,6 +36,13 @@ row. This engine removes both taxes while keeping every shape static
   token budget) is freed when the tick's tokens are processed and
   refilled from the scheduler queue in the same :meth:`step` call — the
   next tick already decodes the new request.
+- **Pipelined loop** (``pipeline=True``): the step becomes a depth-2
+  software pipeline — tick N+1 is planned optimistically and dispatched
+  BEFORE tick N's tokens are read back, so host planning and token
+  streaming overlap device compute; late finishes drop their one
+  overrun token at reconciliation and streams stay bit-identical to
+  the sync loop (kept as the default reference). Every tick's host
+  control arguments ride one packed int32 transfer in both modes.
 - **Paged mode** (``paged=True``): the per-slot slabs become one pool of
   fixed-size KV blocks (:mod:`distkeras_tpu.serving.kvpool`) addressed
   through per-row block tables, with radix-tree prompt-prefix sharing
@@ -71,8 +78,9 @@ import functools
 import sys
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass
-from typing import Any, List, NamedTuple, Optional
+from typing import Any, List, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -115,6 +123,29 @@ def _shard_map(body, mesh, in_specs, out_specs):
         from jax.experimental.shard_map import shard_map
         return shard_map(body, mesh=mesh, in_specs=in_specs,
                          out_specs=out_specs, check_rep=False)
+
+
+def _pack_i32(*arrs) -> np.ndarray:
+    """Flatten a tick's host-side control arguments (block tables, seq
+    lens, fed tokens, valid lens, masks) into ONE int32 buffer so every
+    dispatch pays a single host→device transfer instead of one per
+    array. The unpack order inside the jitted bodies must match the
+    pack order here (:func:`_unpack_i32`)."""
+    return np.concatenate(
+        [np.ascontiguousarray(a, np.int32).ravel() for a in arrs]
+    )
+
+
+def _unpack_i32(packed, shapes):
+    """Static-shape views into a packed control buffer (traced: offsets
+    and shapes are python ints, so the slices compile to free
+    reshapes)."""
+    out, off = [], 0
+    for shp in shapes:
+        n = int(np.prod(shp))
+        out.append(packed[off:off + n].reshape(shp))
+        off += n
+    return out
 
 
 def _freeze(tree, is_leaf=None):
@@ -302,13 +333,18 @@ def _mixed_tick_fn(dm_slot, cfgs, chunk, ctx: Optional[_ShardCtx] = None):
     identical body per head-shard under shard_map — the ``[S, C]``
     chunk semantics (absolute per-row positions, valid-length writes,
     RNG discipline) are untouched, so sharded streams stay
-    bit-identical to the single-chip path."""
+    bit-identical to the single-chip path. Host control arguments
+    (fed tokens, valid lens, sample mask) arrive as ONE packed int32
+    buffer — a single transfer per tick."""
 
-    @functools.partial(_compile, ctx=ctx, in_kinds="pcrrrrr",
+    @functools.partial(_compile, ctx=ctx, in_kinds="pcrrr",
                        out_kinds="crrr", donate=(1, 2, 3))
-    def tick(params_only, cache, last_logits, rngs, fed, valid,
-             sample_mask):
+    def tick(params_only, cache, last_logits, rngs, packed):
         recompiles.note("serve.mixed_tick")
+        S = rngs.shape[0]
+        fed, valid, smask = _unpack_i32(
+            packed, ((S, chunk), (S,), (S,)))
+        sample_mask = smask != 0
         toks, new_rngs = [], []
         for s, (temp, top_k, top_p) in enumerate(cfgs):
             rng, sub = jax.random.split(rngs[s])
@@ -341,13 +377,20 @@ def _paged_mixed_tick_fn(dm_paged, cfgs, chunk,
     """Paged twin of :func:`_mixed_tick_fn`: same fused
     sample/feed/advance semantics, with K/V reads and writes routed
     through each row's block table (chunk padding lands in the reserved
-    trash block)."""
+    trash block). The host control arguments — block tables, seq lens,
+    fed tokens, valid lens, sample mask — ride ONE packed int32
+    transfer (the max_blocks width is recovered from the packed length,
+    so one cached builder serves every pool geometry)."""
 
-    @functools.partial(_compile, ctx=ctx, in_kinds="pcrrrrrrr",
+    @functools.partial(_compile, ctx=ctx, in_kinds="pcrrr",
                        out_kinds="crrr", donate=(1, 2, 3))
-    def tick(params_only, cache, last_logits, rngs, tables, lens, fed,
-             valid, sample_mask):
+    def tick(params_only, cache, last_logits, rngs, packed):
         recompiles.note("serve.paged_mixed_tick")
+        S = rngs.shape[0]
+        MB = packed.shape[0] // S - chunk - 3
+        tables, lens, fed, valid, smask = _unpack_i32(
+            packed, ((S, MB), (S,), (S, chunk), (S,), (S,)))
+        sample_mask = smask != 0
         toks, new_rngs = [], []
         for s, (temp, top_k, top_p) in enumerate(cfgs):
             rng, sub = jax.random.split(rngs[s])
@@ -524,13 +567,19 @@ def _spec_verify_fn(dm_slot, cfgs, W, k, onehot_q,
     variation changes only traced values, never shapes, so steady
     state stays at zero recompiles. Under a mesh ``ctx`` the body runs
     per head-shard with sampling on replicated logits, like every
-    other tick."""
+    other tick. Host int controls (fed, valid, n_forced, sample mask)
+    ride one packed transfer; ``draft_toks`` stays a separate arg
+    because a model drafter's proposals are already device-resident."""
 
-    @functools.partial(_compile, ctx=ctx, in_kinds="pcrrrrrrrr",
+    @functools.partial(_compile, ctx=ctx, in_kinds="pcrrrrr",
                        out_kinds="crrrr", donate=(1, 2, 3))
-    def tick(params_only, cache, last_logits, rngs, fed, valid,
-             n_forced, sample_mask, draft_toks, q_probs):
+    def tick(params_only, cache, last_logits, rngs, packed, draft_toks,
+             q_probs):
         recompiles.note("serve.spec_tick")
+        S = rngs.shape[0]
+        fed, valid, n_forced, smask = _unpack_i32(
+            packed, ((S, W), (S,), (S,), (S,)))
+        sample_mask = smask != 0
         merged = _merge_drafts(fed, valid, n_forced, draft_toks, k)
         logits, vs = dm_slot.apply(
             {**params_only, "cache": cache}, merged,
@@ -561,11 +610,16 @@ def _paged_spec_verify_fn(dm_paged, cfgs, W, k, onehot_q,
     past the chain: window width <= remaining <= the preallocated
     worst case — so rollback touches no block refcounts at all)."""
 
-    @functools.partial(_compile, ctx=ctx, in_kinds="pcrrrrrrrrrr",
+    @functools.partial(_compile, ctx=ctx, in_kinds="pcrrrrr",
                        out_kinds="crrrr", donate=(1, 2, 3))
-    def tick(params_only, cache, last_logits, rngs, tables, lens, fed,
-             valid, n_forced, sample_mask, draft_toks, q_probs):
+    def tick(params_only, cache, last_logits, rngs, packed, draft_toks,
+             q_probs):
         recompiles.note("serve.paged_spec_tick")
+        S = rngs.shape[0]
+        MB = packed.shape[0] // S - W - 4
+        tables, lens, fed, valid, n_forced, smask = _unpack_i32(
+            packed, ((S, MB), (S,), (S, W), (S,), (S,), (S,)))
+        sample_mask = smask != 0
         merged = _merge_drafts(fed, valid, n_forced, draft_toks, k)
         logits, vs = dm_paged.apply(
             {**params_only, "cache": cache}, merged,
@@ -707,12 +761,16 @@ def _reset_slot_cursors(cache, slot):
 def _paged_tick_fn(dm_paged, cfgs, ctx: Optional[_ShardCtx] = None):
     """Paged twin of :func:`_tick_fn`: identical per-slot sampling (same
     RNG chains, same [1, vocab] call shape), then one decode step whose
-    K/V reads/writes go through each row's block table."""
+    K/V reads/writes go through each row's block table. Tables and seq
+    lens arrive as one packed int32 transfer."""
 
-    @functools.partial(_compile, ctx=ctx, in_kinds="pcrrrr",
+    @functools.partial(_compile, ctx=ctx, in_kinds="pcrrr",
                        out_kinds="crrr", donate=(1, 2, 3))
-    def tick(params_only, cache, last_logits, rngs, tables, lens):
+    def tick(params_only, cache, last_logits, rngs, packed):
         recompiles.note("serve.paged_tick")
+        S = rngs.shape[0]
+        MB = packed.shape[0] // S - 1
+        tables, lens = _unpack_i32(packed, ((S, MB), (S,)))
         toks, new_rngs = [], []
         for s, (temp, top_k, top_p) in enumerate(cfgs):
             rng, sub = jax.random.split(rngs[s])
@@ -767,6 +825,39 @@ class _SlotState:
     history: Optional[np.ndarray] = None
     draft_queue: Optional[np.ndarray] = None
     draft_rewind: int = 0
+
+
+@dataclass
+class _InflightTick:
+    """One dispatched-but-unread tick: the device-side token refs plus
+    the host plan that produced them. Sync mode reconciles the record
+    immediately after dispatch; the pipelined loop holds exactly one
+    while the NEXT tick is planned and dispatched, so host planning and
+    token streaming for tick N overlap device compute of tick N+1.
+    ``rows`` pins the exact :class:`_SlotState` each row was planned
+    against — reconciliation drops a row's token when the slot no
+    longer holds that state (the request finished in an
+    earlier-reconciled tick after this one was optimistically
+    dispatched: the late-EOS overrun, never emitted). Only tick
+    OUTPUTS are held here; the donated inputs (cache/logits/rngs) were
+    rebound by the dispatch statement and must never be parked on a
+    record that outlives the step (the donation-safety pass checks
+    this handoff)."""
+
+    toks: Any                       # device [S] (or [S, k+1] spec)
+    # per slot: None (idle at plan) | ("dec", st) | ("pre", st, take,
+    # flipped) — flipped marks the prompt's last chunk landing
+    rows: List[Optional[tuple]]
+    plan_ms: float
+    dispatch_ms: float
+    n_dec: int
+    fed_tokens: int
+    chunk: Optional[int]
+    # speculative extras (depth-1 pipeline: emissions defer, plans don't)
+    acc: Any = None                 # device [S] accepted-prefix lengths
+    n_forced: Optional[np.ndarray] = None
+    granted: Optional[np.ndarray] = None
+    spec_set: Optional[set] = None
 
 
 class ServingEngine:
@@ -872,6 +963,25 @@ class ServingEngine:
       spec_k: draft tokens proposed per row per tick (default 4).
       ngram_max: longest suffix n-gram the ``"ngram"`` drafter matches
         (default 3).
+      pipeline: overlap host planning and token streaming with device
+        compute (the DOWNPOUR thesis applied to the tick loop: never
+        stall either side on the other). ``True`` turns the loop into a
+        depth-2 software pipeline — tick N+1 is planned optimistically
+        (as if no row finished in tick N) and dispatched BEFORE tick
+        N's tokens are read back, so the device starts the next step
+        while the host streams the previous one. When tick N's tokens
+        land and a row HAD finished (late EOS / length), that row's
+        tick-N+1 token is an overrun: dropped before streaming, the
+        slot cancelled and refilled on tick N+2 (RNG chains die with
+        the request, so greedy AND sampled streams stay bit-identical
+        to the sync loop). Slots and blocks are only freed at
+        reconciliation, so plan-ahead can never double-admit against
+        an unreconciled finish. Speculative engines run a depth-1
+        pipeline instead (the next plan needs the accepted tokens):
+        readback and bookkeeping stay synchronous, but emission and
+        telemetry are deferred past the next dispatch. ``False`` (the
+        default) keeps the strictly alternating loop as the bit-parity
+        reference, same policy as ``paged_kernel='gather'``.
       device: pin this engine's device-side state (weights, cache,
         logits, RNG chains) to one specific :class:`jax.Device` — the
         multi-replica pattern, where N single-chip engines in one
@@ -901,7 +1011,8 @@ class ServingEngine:
                  mesh=None, tp_axis: str = "model",
                  paged_kernel: str = "auto",
                  draft=None, draft_params=None, spec_k: int = 4,
-                 ngram_max: int = 3, device=None):
+                 ngram_max: int = 3, device=None,
+                 pipeline: bool = False):
         if slots < 1:
             raise ValueError(f"slots must be >= 1; got {slots}")
         if prefill_chunk is not None and prefill_chunk < 1:
@@ -911,6 +1022,15 @@ class ServingEngine:
             )
         self.prefill_chunk = prefill_chunk
         self._admit_seq = 0
+        # pipelined loop: dispatched-but-unread ticks (at most one in
+        # steady state), the packed-control-buffer reuse cache (an
+        # unchanged plan re-dispatches the previous device buffer —
+        # zero per-tick uploads in an all-decode steady state), and the
+        # dropped-overrun accounting
+        self.pipeline = pipeline
+        self._pending: deque = deque()
+        self._packed_prev: Tuple[Optional[np.ndarray], Any] = (None, None)
+        self.overrun_tokens = 0
         # speculative decoding: a drafter proposes up to spec_k tokens
         # per decoding row per tick; the flagship verifies them in one
         # fused window and accepts a prefix by rejection sampling
@@ -1295,6 +1415,18 @@ class ServingEngine:
         self._m_decode_tps = reg.gauge(
             "serving_decode_tokens_per_sec",
             "tokens emitted by the latest tick over its wall time")
+        # pipelined loop (PR 10): how long the host actually BLOCKED on
+        # the device per tick (sync mode: the whole compute; pipelined:
+        # what overlap could not hide), and tokens computed for rows
+        # that had already finished when their tick was reconciled
+        self._m_device_wait = reg.histogram(
+            "serving_device_wait_ms",
+            "host time blocked on device readback per tick (ms) — the "
+            "overlap headroom sync mode wastes and pipeline=True hides")
+        self._m_overrun = reg.counter(
+            "serving_overrun_tokens_total",
+            "optimistically computed tokens dropped at reconciliation "
+            "because their row had finished (pipeline=True late EOS)")
         self._m_prefix_hit = reg.counter(
             "serving_prefix_hit_tokens_total",
             "prompt tokens served from the radix prefix cache "
@@ -1414,6 +1546,8 @@ class ServingEngine:
             raise
 
     def _step(self) -> bool:
+        if self.pipeline:
+            return self._pipelined_step()
         n_prefills = self._admit()
         occupied = any(st is not None for st in self._slots)
         if occupied:
@@ -1434,6 +1568,47 @@ class ServingEngine:
                 # fraction inside _mixed_tick instead
                 self._m_prefill_frac.observe(n_prefills / (n_prefills + 1))
         return occupied or self.scheduler.depth() > 0
+
+    def _pipelined_step(self) -> bool:
+        """One pipelined scheduler iteration. Non-speculative engines
+        run depth-2: admit, plan tick N+1 OPTIMISTICALLY (every planned
+        row is assumed to continue — finishes in the still-unread tick
+        N are unknown), dispatch it, and only then reconcile tick N —
+        materialize its tokens (the device is already running N+1),
+        stream them, drop overruns for rows that turn out to have
+        finished earlier, and free/complete slots (refilled by the next
+        step's admit, i.e. on tick N+2). Speculative engines run
+        depth-1: the next plan NEEDS the accepted tokens (pending
+        token, n-gram history), so reconciliation runs first, but token
+        emission and telemetry are deferred until after the next
+        dispatch — the device computes tick N+1 while the host streams
+        tick N."""
+        if self.spec:
+            defer: list = []
+            while self._pending:
+                self._reconcile_spec(self._pending.popleft(), defer)
+            self._admit()
+            occupied = any(st is not None for st in self._slots)
+            if occupied:
+                self._pending.append(self._plan_dispatch_spec())
+            self._flush_emissions(defer)
+            return (occupied or self.scheduler.depth() > 0
+                    or bool(self._pending))
+        self._admit()
+        occupied = any(st is not None for st in self._slots)
+        if occupied:
+            rec = (self._plan_dispatch_mixed()
+                   if self.prefill_chunk is not None
+                   else self._plan_dispatch_decode())
+            self._pending.append(rec)
+        # keep exactly one tick unreconciled while occupied (the
+        # pipeline depth); flush everything once the pool idles so the
+        # last streams always complete
+        keep = 1 if occupied else 0
+        while len(self._pending) > keep:
+            self._reconcile(self._pending.popleft())
+        return (occupied or self.scheduler.depth() > 0
+                or bool(self._pending))
 
     def serve_forever(self, stop: threading.Event,
                       idle_sleep: float = 0.002):
@@ -1736,14 +1911,37 @@ class ServingEngine:
             self._m_prefix_hit.inc(cached)
 
     def _mixed_tick(self):
-        """One fused mixed prefill/decode tick: deal the token budget
-        (decodes first, then prompt chunks in admission order), run ONE
-        ``[S, C]`` dispatch advancing every row at its own valid
-        length, emit the decoding rows' sampled tokens, flip rows whose
-        last chunk landed to DECODING, and complete/free EOS'd or
-        exhausted rows. When no prefill token was dealt this tick the
-        dispatch shrinks to the plain ``[S, 1]`` decode shape — an
-        all-decode steady state pays exactly the unchunked tick."""
+        """One fused mixed prefill/decode tick, sync mode: plan and
+        dispatch, then reconcile immediately (the strictly alternating
+        reference loop). ``pipeline=True`` calls the same two halves
+        with the NEXT dispatch between them."""
+        self._reconcile(self._plan_dispatch_mixed())
+
+    def _upload(self, packed: np.ndarray):
+        """One packed control-buffer transfer per tick — and zero when
+        the plan is unchanged from the previous tick (the all-decode
+        slot-mode steady state): the previous device buffer is
+        re-dispatched outright. Safe because the packed buffer is never
+        donated and each tick's host array is freshly built (the old
+        copy-and-rebind aliasing discipline still guards the raw
+        tables/lens arrays used by the monolithic prefill paths)."""
+        prev_host, prev_dev = self._packed_prev
+        if (prev_host is not None and prev_host.shape == packed.shape
+                and np.array_equal(prev_host, packed)):
+            return prev_dev
+        dev = jnp.asarray(packed)
+        self._packed_prev = (packed, dev)
+        return dev
+
+    def _plan_dispatch_mixed(self) -> _InflightTick:
+        """Plan one mixed tick from host state only — deal the token
+        budget (decodes first, then prompt chunks in admission order),
+        advance each prefilling row's pending queue and flip rows whose
+        last chunk is being fed to DECODING (all host-known) — then
+        dispatch ONE ``[S, C]`` valid-length dispatch without touching
+        the device results. When no prefill token was dealt the shape
+        shrinks to the plain ``[S, 1]`` decode tick. Returns the
+        in-flight record :meth:`_reconcile` later materializes."""
         t_plan0 = time.perf_counter()
         S = self.slots
         cfgs = tuple(
@@ -1764,79 +1962,181 @@ class ServingEngine:
         C = self.prefill_chunk if fed_tokens else 1
         fed = np.zeros((S, C), np.int32)
         valid = np.zeros((S,), np.int32)
-        sample_mask = np.zeros((S,), bool)
+        sample_mask = np.zeros((S,), np.int32)
+        rows: List[Optional[tuple]] = [None] * S
         for s, st in enumerate(self._slots):
-            if st is None or st.decoding:
+            if st is None:
                 # idle rows tick along like decoders (sampling greedily
                 # into the void at their parked cursor, as the unchunked
                 # tick always has)
                 valid[s] = 1
-                sample_mask[s] = True
+                sample_mask[s] = 1
+            elif st.decoding:
+                valid[s] = 1
+                sample_mask[s] = 1
+                rows[s] = ("dec", st)
         for (s, st), take in zip(pre, takes):
+            flipped = False
             if take > 0:
                 fed[s, :take] = st.pending[:take]
                 valid[s] = take
+                st.pending = st.pending[take:]
+                if st.pending.size == 0:
+                    # last chunk dealt: this dispatch leaves the
+                    # prompt-final logits at the row's last valid token
+                    # — the NEXT tick samples its first token
+                    st.decoding = True
+                    flipped = True
             # take == 0: starved this tick — valid stays 0, the row
             # writes nothing and its cursor holds
+            rows[s] = ("pre", st, take, flipped)
+        if self.paged:
+            # REBIND, never mutate (aliasing hazard, see _decode_tick):
+            # live rows advance by what the dispatch consumes; idle rows
+            # stay parked at 0 on the trash block
+            adv = np.zeros((S,), np.int32)
+            for s, row in enumerate(rows):
+                if row is not None:
+                    adv[s] = 1 if row[0] == "dec" else valid[s]
+            packed = _pack_i32(self._block_tables, self._seq_lens, fed,
+                               valid, sample_mask)
+            self._seq_lens = self._seq_lens + adv
+        else:
+            packed = _pack_i32(fed, valid, sample_mask)
         t0 = time.perf_counter()
         plan_ms = (t0 - t_plan0) * 1e3
+        dev = self._upload(packed)
         if self.paged:
             tick = _paged_mixed_tick_fn(self._dm_paged, cfgs, C,
                                         self._ctx)
-            self._cache, self._last_logits, toks, self._rngs = tick(
-                self._params_only, self._cache, self._last_logits,
-                self._rngs, jnp.asarray(self._block_tables),
-                jnp.asarray(self._seq_lens), jnp.asarray(fed),
-                jnp.asarray(valid), jnp.asarray(sample_mask),
-            )
-            # REBIND, never mutate (aliasing hazard, see _decode_tick):
-            # live rows advance by what they consumed; idle rows stay
-            # parked at 0 on the trash block
-            adv = np.zeros((S,), np.int32)
-            for s, st in enumerate(self._slots):
-                if st is not None:
-                    adv[s] = 1 if st.decoding else valid[s]
-            self._seq_lens = self._seq_lens + adv
         else:
             tick = _mixed_tick_fn(self._dm_slot, cfgs, C, self._ctx)
-            self._cache, self._last_logits, toks, self._rngs = tick(
-                self._params_only, self._cache, self._last_logits,
-                self._rngs, jnp.asarray(fed), jnp.asarray(valid),
-                jnp.asarray(sample_mask),
-            )
-        toks_host = np.asarray(toks)  # forces completion of the tick
-        tick_ms = (time.perf_counter() - t0) * 1e3
+        self._cache, self._last_logits, toks, self._rngs = tick(
+            self._params_only, self._cache, self._last_logits,
+            self._rngs, dev,
+        )
+        return _InflightTick(
+            toks=toks, rows=rows, plan_ms=plan_ms,
+            dispatch_ms=(time.perf_counter() - t0) * 1e3,
+            n_dec=n_dec, fed_tokens=fed_tokens, chunk=C,
+        )
+
+    def _reconcile(self, rec: _InflightTick):
+        """Materialize one dispatched tick and settle the host side:
+        block on its token readback (in pipelined mode the device is
+        already running the NEXT tick, so this wait shrinks by whatever
+        the overlap hid), stream each planned row's token, complete
+        EOS'd/exhausted rows, drop overrun tokens whose row finished in
+        an earlier reconcile, and record telemetry + the flight
+        snapshot."""
+        t_wait0 = time.perf_counter()
+        toks_host = np.asarray(rec.toks)  # forces completion of the tick
+        wait_ms = (time.perf_counter() - t_wait0) * 1e3
         t_stream0 = time.perf_counter()
         self.ticks += 1
         occupancy = sum(st is not None for st in self._slots)
         self._occ_sum += occupancy
         now = time.monotonic()
         emitted = 0
-        for s, st in enumerate(self._slots):
-            if st is None:
+        overrun = 0
+        for s, row in enumerate(rec.rows):
+            if row is None:
                 continue
-            req = st.req
-            if not st.decoding:
-                take = int(valid[s])
-                if take > 0:
-                    st.pending = st.pending[take:]
-                    if st.pending.size == 0:
-                        # last chunk landed: this tick's logits at the
-                        # row's final valid token are the prompt-final
-                        # logits — the NEXT tick samples the first token
-                        st.decoding = True
-                        req.prefill_done_t = now
-                        prefill_ms = (now - st.admit_t) * 1e3
-                        self.tracer.record(
-                            req.trace_id, "prefill", st.admit_t,
-                            prefill_ms, slot=s,
-                            prompt_tokens=int(req.prompt.size),
-                            cached_tokens=st.cached_tokens,
-                            chunk=self.prefill_chunk,
-                        )
-                        self._m_prefill_ms.observe(prefill_ms)
+            st = row[1]
+            if self._slots[s] is not st:
+                # late finish: this row's request completed while the
+                # tick was in flight (reconciled out of an earlier
+                # record) — its optimistically computed token is an
+                # overrun, dropped before any consumer sees it. RNG
+                # parity holds because the chain died with the request
+                # (the refill reseeds the slot's key).
+                if row[0] == "dec":
+                    overrun += 1
                 continue
-            tok = int(toks_host[s])
+            if row[0] == "pre":
+                if row[3]:  # the prompt's last chunk landed this tick
+                    req = st.req
+                    req.prefill_done_t = now
+                    prefill_ms = (now - st.admit_t) * 1e3
+                    self.tracer.record(
+                        req.trace_id, "prefill", st.admit_t,
+                        prefill_ms, slot=s,
+                        prompt_tokens=int(req.prompt.size),
+                        cached_tokens=st.cached_tokens,
+                        chunk=self.prefill_chunk,
+                    )
+                    self._m_prefill_ms.observe(prefill_ms)
+                continue
+            e, _ = self._stream_row(s, st, [int(toks_host[s])], now)
+            emitted += e
+        if overrun:
+            self.overrun_tokens += overrun
+            self._m_overrun.inc(overrun)
+        queue_depth = self.scheduler.depth()
+        device_ms = rec.dispatch_ms + wait_ms
+        self._m_ticks.inc()
+        self._m_tokens.inc(emitted)
+        self._m_occupancy.set(sum(st is not None for st in self._slots))
+        self._m_tick_ms.observe(device_ms)
+        self._m_device_wait.observe(wait_ms)
+        if rec.chunk is not None and rec.fed_tokens + rec.n_dec > 0:
+            self._m_prefill_frac.observe(
+                rec.fed_tokens / (rec.fed_tokens + rec.n_dec))
+        if device_ms > 0:
+            self._m_decode_tps.set(round(emitted / (device_ms / 1e3), 3))
+        log_kw = ({"prefill_tokens": rec.fed_tokens}
+                  if rec.chunk is not None else {})
+        self.metrics.log(
+            step=self.ticks, occupancy=occupancy,
+            queue_depth=queue_depth,
+            token_ms=round(device_ms, 3), **log_kw,
+        )
+        self._record_tick(
+            plan_ms=rec.plan_ms, device_ms=device_ms,
+            stream_ms=(time.perf_counter() - t_stream0) * 1e3,
+            n_dec=rec.n_dec, prefill_tokens=rec.fed_tokens,
+            chunk=rec.chunk,
+            emitted=emitted, occupancy=occupancy,
+            queue_depth=queue_depth,
+            device_wait_ms=wait_ms, dispatch_ms=rec.dispatch_ms,
+            overrun=overrun,
+        )
+
+    def _stream_row(self, s: int, st: _SlotState, toks_row, now,
+                    defer: Optional[list] = None):
+        """Emit one row's tick tokens to its consumer stream, stopping
+        at EOS or budget exhaustion (which completes the slot). Shared
+        by every tick path. ``defer`` switches to the pipelined-spec
+        discipline: bookkeeping (remaining, n_emitted, completion,
+        slot freeing) happens NOW — the next plan needs it — while the
+        consumer-visible emission (stream puts, TTFT/ITL marks, the
+        finish sentinel) is queued for :meth:`_flush_emissions` after
+        the next dispatch."""
+        req = st.req
+        take: List[int] = []
+        done = False
+        reason = None
+        for tok in toks_row:
+            take.append(tok)
+            req.n_emitted += 1
+            st.remaining -= 1
+            self.tokens_generated += 1
+            if req.eos_id is not None and tok == req.eos_id:
+                done, reason = True, "eos"
+                break
+            if st.remaining == 0:
+                done, reason = True, "length"
+                break
+        if defer is None:
+            self._emit_now(req, take, now)
+        else:
+            defer.append(("toks", req, take))
+        if done:
+            self._complete(s, reason, defer=defer)
+        return len(take), done
+
+    def _emit_now(self, req: Request, toks, now):
+        for tok in toks:
             if req.first_token_t is None:
                 req.first_token_t = now
                 self._m_ttft_ms.observe((now - req.submit_t) * 1e3)
@@ -1844,36 +2144,19 @@ class ServingEngine:
                 self._m_itl_ms.observe((now - req.last_token_t) * 1e3)
             req.last_token_t = now
             req.stream._put(tok)
-            req.n_emitted += 1
-            st.remaining -= 1
-            self.tokens_generated += 1
-            emitted += 1
-            if req.eos_id is not None and tok == req.eos_id:
-                self._complete(s, "eos")
-            elif st.remaining == 0:
-                self._complete(s, "length")
-        queue_depth = self.scheduler.depth()
-        self._m_ticks.inc()
-        self._m_tokens.inc(emitted)
-        self._m_occupancy.set(sum(st is not None for st in self._slots))
-        self._m_tick_ms.observe(tick_ms)
-        if fed_tokens + n_dec > 0:
-            self._m_prefill_frac.observe(fed_tokens / (fed_tokens + n_dec))
-        if tick_ms > 0:
-            self._m_decode_tps.set(round(emitted / (tick_ms / 1e3), 3))
-        self.metrics.log(
-            step=self.ticks, occupancy=occupancy,
-            queue_depth=queue_depth,
-            token_ms=round(tick_ms, 3),
-            prefill_tokens=fed_tokens,
-        )
-        self._record_tick(
-            plan_ms=plan_ms, device_ms=tick_ms,
-            stream_ms=(time.perf_counter() - t_stream0) * 1e3,
-            n_dec=n_dec, prefill_tokens=fed_tokens, chunk=C,
-            emitted=emitted, occupancy=occupancy,
-            queue_depth=queue_depth,
-        )
+
+    def _flush_emissions(self, defer: list):
+        """Deliver deferred token puts and finish sentinels (pipelined
+        spec mode), in the exact order bookkeeping produced them — a
+        request's finish always lands after its final tokens."""
+        if not defer:
+            return
+        now = time.monotonic()
+        for item in defer:
+            if item[0] == "toks":
+                self._emit_now(item[1], item[2], now)
+            else:
+                self._notify_finish(item[1], item[2], item[3])
 
     # -- speculative decoding (draft-assisted verify ticks) ------------------
 
@@ -1940,16 +2223,20 @@ class ServingEngine:
         return jnp.stack(qs_l, axis=1), jnp.stack(toks_l, axis=1)
 
     def _spec_tick(self):
-        """One speculative mixed tick: plan per-row verify windows
+        """One speculative mixed tick, sync mode: plan+dispatch, then
+        reconcile immediately with inline emission."""
+        self._reconcile_spec(self._plan_dispatch_spec(), None)
+
+    def _plan_dispatch_spec(self) -> _InflightTick:
+        """Plan one speculative verify tick: per-row verify windows
         (pending token + granted draft width) and prompt chunks under
         the shared token budget, run the drafter (model steps or
-        host-side n-gram lookup), verify everything in ONE fused
-        ``[S, W]`` dispatch with per-row rejection sampling and
-        in-dispatch rollback, then emit each row's accepted prefix
-        plus its extra token. Acceptance-length variation changes only
-        traced values — steady state compiles exactly two shapes
-        (``[S, k+1]`` all-decode, ``[S, max(C, k+1)]`` with chunks),
-        like the non-speculative mixed tick."""
+        host-side n-gram lookup), and dispatch the fused ``[S, W]``
+        verify with per-row rejection sampling and in-dispatch
+        rollback. Acceptance-length variation changes only traced
+        values — steady state compiles exactly two shapes (``[S,
+        k+1]`` all-decode, ``[S, max(C, k+1)]`` with chunks), like the
+        non-speculative mixed tick."""
         t_plan0 = time.perf_counter()
         S, k = self.slots, self.spec_k
         cfgs = tuple(
@@ -1993,11 +2280,13 @@ class ServingEngine:
         fed = np.zeros((S, W), np.int32)
         valid = np.zeros((S,), np.int32)
         n_forced = np.zeros((S,), np.int32)
-        sample_mask = np.zeros((S,), bool)
+        sample_mask = np.zeros((S,), np.int32)
         draft_np = np.zeros((S, k), np.int32)
         granted = np.zeros((S,), np.int32)
+        rows: List[Optional[tuple]] = [None] * S
         for s, st in dec:
-            sample_mask[s] = True
+            sample_mask[s] = 1
+            rows[s] = ("dec", st)
             if st.pending_tok is not None:
                 fed[s, 0] = st.pending_tok
                 n_forced[s] = 1
@@ -2008,10 +2297,19 @@ class ServingEngine:
             if self.draft_kind == "ngram":
                 draft_np[s] = ngram_toks[s]
         for (s, st), take in zip(pre, takes):
+            flipped = False
             if take > 0:
                 fed[s, :take] = st.pending[:take]
                 valid[s] = take
                 n_forced[s] = take
+                st.pending = st.pending[take:]
+                if st.pending.size == 0:
+                    # last chunk dealt: the next tick is this row's
+                    # transition tick (samples its first token, which
+                    # becomes the pending token)
+                    st.decoding = True
+                    flipped = True
+            rows[s] = ("pre", st, take, flipped)
         t0 = time.perf_counter()
         plan_ms = (t0 - t_plan0) * 1e3
         if self.draft_kind == "model":
@@ -2021,94 +2319,84 @@ class ServingEngine:
             draft_dev = jnp.asarray(draft_np)
         onehot = self.draft_kind == "ngram"
         if self.paged:
+            packed = _pack_i32(self._block_tables, self._seq_lens, fed,
+                               valid, n_forced, sample_mask)
             tick = _paged_spec_verify_fn(self._dm_paged, cfgs, W, k,
                                          onehot, self._ctx)
-            (self._cache, self._last_logits, toks, acc,
-             self._rngs) = tick(
-                self._params_only, self._cache, self._last_logits,
-                self._rngs, jnp.asarray(self._block_tables),
-                jnp.asarray(self._seq_lens), jnp.asarray(fed),
-                jnp.asarray(valid), jnp.asarray(n_forced),
-                jnp.asarray(sample_mask), draft_dev, q_probs,
-            )
         else:
+            packed = _pack_i32(fed, valid, n_forced, sample_mask)
             tick = _spec_verify_fn(self._dm_slot, cfgs, W, k, onehot,
                                    self._ctx)
-            (self._cache, self._last_logits, toks, acc,
-             self._rngs) = tick(
-                self._params_only, self._cache, self._last_logits,
-                self._rngs, jnp.asarray(fed), jnp.asarray(valid),
-                jnp.asarray(n_forced), jnp.asarray(sample_mask),
-                draft_dev, q_probs,
-            )
-        toks_host = np.asarray(toks)  # forces completion of the tick
-        acc_host = np.asarray(acc)
+        dev = self._upload(packed)
+        (self._cache, self._last_logits, toks, acc,
+         self._rngs) = tick(
+            self._params_only, self._cache, self._last_logits,
+            self._rngs, dev, draft_dev, q_probs,
+        )
+        return _InflightTick(
+            toks=toks, rows=rows, plan_ms=plan_ms,
+            dispatch_ms=(time.perf_counter() - t0) * 1e3,
+            n_dec=len(dec), fed_tokens=fed_tokens, chunk=W,
+            acc=acc, n_forced=n_forced, granted=granted,
+            spec_set=spec_set,
+        )
+
+    def _reconcile_spec(self, rec: _InflightTick,
+                        defer: Optional[list]):
+        """Materialize one verify tick and settle the host side: read
+        back tokens AND accepted-prefix lengths (the next plan depends
+        on both — pending tokens, n-gram history, paged cursor
+        arithmetic), emit each row's accepted prefix plus its extra
+        token, and do the draft-cache lag bookkeeping. With ``defer``
+        (pipelined mode) the consumer-visible emission is queued and
+        flushed after the NEXT dispatch; all scheduling state still
+        settles here."""
+        k = self.spec_k
+        t_wait0 = time.perf_counter()
+        toks_host = np.asarray(rec.toks)  # forces completion of the tick
+        acc_host = np.asarray(rec.acc)
+        wait_ms = (time.perf_counter() - t_wait0) * 1e3
         if self.paged:
             # REBIND, never mutate (aliasing hazard, see _decode_tick):
             # each row keeps only its forced tokens plus the accepted
             # prefix — the rejected-suffix rollback IS this arithmetic
             self._seq_lens = self._seq_lens + (
-                n_forced + acc_host).astype(np.int32)
-        tick_ms = (time.perf_counter() - t0) * 1e3
+                rec.n_forced + acc_host).astype(np.int32)
         t_stream0 = time.perf_counter()
         self.ticks += 1
         occupancy = sum(st is not None for st in self._slots)
         self._occ_sum += occupancy
         now = time.monotonic()
         emitted = 0
-        proposed = int(granted.sum())
+        proposed = int(rec.granted.sum())
         accepted = 0
-        for s, st in enumerate(self._slots):
-            if st is None:
+        for s, row in enumerate(rec.rows):
+            if row is None:
                 continue
-            req = st.req
-            if not st.decoding:
-                take = int(valid[s])
-                if take > 0:
-                    st.pending = st.pending[take:]
-                    if st.pending.size == 0:
-                        # last chunk landed: next tick is this row's
-                        # transition tick (samples its first token,
-                        # which becomes the pending token)
-                        st.decoding = True
-                        req.prefill_done_t = now
-                        prefill_ms = (now - st.admit_t) * 1e3
-                        self.tracer.record(
-                            req.trace_id, "prefill", st.admit_t,
-                            prefill_ms, slot=s,
-                            prompt_tokens=int(req.prompt.size),
-                            cached_tokens=st.cached_tokens,
-                            chunk=self.prefill_chunk,
-                        )
-                        self._m_prefill_ms.observe(prefill_ms)
+            st = row[1]
+            if self._slots[s] is not st:
+                continue  # late finish (cannot happen at depth 1)
+            if row[0] == "pre":
+                if row[3]:
+                    req = st.req
+                    req.prefill_done_t = now
+                    prefill_ms = (now - st.admit_t) * 1e3
+                    self.tracer.record(
+                        req.trace_id, "prefill", st.admit_t,
+                        prefill_ms, slot=s,
+                        prompt_tokens=int(req.prompt.size),
+                        cached_tokens=st.cached_tokens,
+                        chunk=self.prefill_chunk,
+                    )
+                    self._m_prefill_ms.observe(prefill_ms)
                 continue
             a = int(acc_host[s])
-            if granted[s] > 0:
+            if rec.granted[s] > 0:
                 accepted += a
                 self._m_accept_len.observe(a)
             toks_row = [int(t) for t in toks_host[s, :a + 1]]
-            done = False
-            for tok in toks_row:
-                if req.first_token_t is None:
-                    req.first_token_t = now
-                    self._m_ttft_ms.observe((now - req.submit_t) * 1e3)
-                else:
-                    self._m_itl_ms.observe(
-                        (now - req.last_token_t) * 1e3)
-                req.last_token_t = now
-                req.stream._put(tok)
-                req.n_emitted += 1
-                st.remaining -= 1
-                self.tokens_generated += 1
-                emitted += 1
-                if req.eos_id is not None and tok == req.eos_id:
-                    self._complete(s, "eos")
-                    done = True
-                    break
-                if st.remaining == 0:
-                    self._complete(s, "length")
-                    done = True
-                    break
+            e, done = self._stream_row(s, st, toks_row, now, defer)
+            emitted += e
             if done:
                 continue
             st.pending_tok = toks_row[-1]
@@ -2117,7 +2405,7 @@ class ServingEngine:
                     [st.history, np.asarray(toks_row, np.int32)])
             if self.draft_kind == "model":
                 lag = []
-                if s in spec_set and a == k:
+                if s in rec.spec_set and a == k:
                     # every proposal survived: the k-th was accepted
                     # but never fed to the draft (only d_1..d_{k-1}
                     # were) — it precedes the extra token in the queue
@@ -2127,124 +2415,121 @@ class ServingEngine:
                 st.draft_queue = (
                     np.concatenate([st.draft_queue, lag_np])
                     if st.draft_queue.size else lag_np)
-                if s in spec_set:
+                if s in rec.spec_set:
                     st.draft_rewind = max(k - 1 - a, 0)
         self.draft_tokens_proposed += proposed
         self.draft_tokens_accepted += accepted
         self._m_draft_tokens.inc(proposed)
         self._m_accepted_tokens.inc(accepted)
         queue_depth = self.scheduler.depth()
+        device_ms = rec.dispatch_ms + wait_ms
         self._m_ticks.inc()
         self._m_tokens.inc(emitted)
         self._m_occupancy.set(sum(st is not None for st in self._slots))
-        self._m_tick_ms.observe(tick_ms)
-        if fed_tokens + len(dec) > 0:
+        self._m_tick_ms.observe(device_ms)
+        self._m_device_wait.observe(wait_ms)
+        if rec.fed_tokens + rec.n_dec > 0:
             self._m_prefill_frac.observe(
-                fed_tokens / (fed_tokens + len(dec)))
-        if tick_ms > 0:
-            self._m_decode_tps.set(round(emitted / (tick_ms / 1e3), 3))
+                rec.fed_tokens / (rec.fed_tokens + rec.n_dec))
+        if device_ms > 0:
+            self._m_decode_tps.set(round(emitted / (device_ms / 1e3), 3))
         self.metrics.log(
             step=self.ticks, occupancy=occupancy,
             queue_depth=queue_depth,
-            token_ms=round(tick_ms, 3),
-            prefill_tokens=fed_tokens,
+            token_ms=round(device_ms, 3),
+            prefill_tokens=rec.fed_tokens,
             draft_tokens=proposed, accepted_tokens=accepted,
         )
         self._record_tick(
-            plan_ms=plan_ms, device_ms=tick_ms,
+            plan_ms=rec.plan_ms, device_ms=device_ms,
             stream_ms=(time.perf_counter() - t_stream0) * 1e3,
-            n_dec=len(dec), prefill_tokens=fed_tokens, chunk=W,
+            n_dec=rec.n_dec, prefill_tokens=rec.fed_tokens,
+            chunk=rec.chunk,
             emitted=emitted, occupancy=occupancy,
             queue_depth=queue_depth,
             draft_tokens=proposed, accepted_tokens=accepted,
+            device_wait_ms=wait_ms, dispatch_ms=rec.dispatch_ms,
         )
 
     def _decode_tick(self):
+        """One plain decode tick (monolithic-prefill mode), sync:
+        plan+dispatch then reconcile immediately."""
+        self._reconcile(self._plan_dispatch_decode())
+
+    def _plan_dispatch_decode(self) -> _InflightTick:
         t_plan0 = time.perf_counter()
         cfgs = tuple(
             (st.req.temperature, st.req.top_k, st.req.top_p)
             if st else _IDLE_CFG
             for st in self._slots
         )
+        rows: List[Optional[tuple]] = [
+            ("dec", st) if st is not None else None
+            for st in self._slots
+        ]
+        n_dec = sum(1 for r in rows if r is not None)
+        if self.paged:
+            # the tick writes each live row's K/V at its cursor; advance
+            # the host-owned cursors (idle rows stay parked at 0 on the
+            # trash block). REBIND, never mutate: jnp.asarray can alias
+            # the numpy buffer zero-copy while the async tick still
+            # reads it — in-place writes would race the device
+            packed = _pack_i32(self._block_tables, self._seq_lens)
+            alive = np.fromiter(
+                (st is not None for st in self._slots), bool, self.slots
+            )
+            self._seq_lens = self._seq_lens + alive.astype(np.int32)
         t0 = time.perf_counter()
         plan_ms = (t0 - t_plan0) * 1e3
         if self.paged:
             tick = _paged_tick_fn(self._dm_paged, cfgs, self._ctx)
             self._cache, self._last_logits, toks, self._rngs = tick(
                 self._params_only, self._cache, self._last_logits,
-                self._rngs, jnp.asarray(self._block_tables),
-                jnp.asarray(self._seq_lens),
+                self._rngs, self._upload(packed),
             )
-            # the tick wrote each live row's K/V at its cursor; advance
-            # the host-owned cursors (idle rows stay parked at 0 on the
-            # trash block). REBIND, never mutate: jnp.asarray can alias
-            # the numpy buffer zero-copy while the async tick still
-            # reads it — in-place writes would race the device
-            alive = np.fromiter(
-                (st is not None for st in self._slots), bool, self.slots
-            )
-            self._seq_lens = self._seq_lens + alive.astype(np.int32)
         else:
             tick = _tick_fn(self._dm_slot, cfgs, self._ctx)
             self._cache, self._last_logits, toks, self._rngs = tick(
                 self._params_only, self._cache, self._last_logits,
                 self._rngs
             )
-        toks_host = np.asarray(toks)  # forces completion of the tick
-        tick_ms = (time.perf_counter() - t0) * 1e3
-        t_stream0 = time.perf_counter()
-        self.ticks += 1
-        occupancy = sum(st is not None for st in self._slots)
-        self._occ_sum += occupancy
-        now = time.monotonic()
-        emitted = 0
-        for s, st in enumerate(self._slots):
-            if st is None:
-                continue
-            req = st.req
-            tok = int(toks_host[s])
-            if req.first_token_t is None:
-                # TTFT lands in the per-request summary at completion
-                req.first_token_t = now
-                self._m_ttft_ms.observe(
-                    (now - req.submit_t) * 1e3
-                )
-            else:
-                self._m_itl_ms.observe((now - req.last_token_t) * 1e3)
-            req.last_token_t = now
-            req.stream._put(tok)
-            req.n_emitted += 1
-            st.remaining -= 1
-            self.tokens_generated += 1
-            emitted += 1
-            if req.eos_id is not None and tok == req.eos_id:
-                self._complete(s, "eos")
-            elif st.remaining == 0:
-                self._complete(s, "length")
-        queue_depth = self.scheduler.depth()
-        self._m_ticks.inc()
-        self._m_tokens.inc(emitted)
-        self._m_occupancy.set(sum(st is not None for st in self._slots))
-        self._m_tick_ms.observe(tick_ms)
-        if tick_ms > 0:
-            self._m_decode_tps.set(round(emitted / (tick_ms / 1e3), 3))
-        self.metrics.log(
-            step=self.ticks, occupancy=occupancy,
-            queue_depth=queue_depth,
-            token_ms=round(tick_ms, 3),
-        )
-        self._record_tick(
-            plan_ms=plan_ms, device_ms=tick_ms,
-            stream_ms=(time.perf_counter() - t_stream0) * 1e3,
-            n_dec=occupancy, prefill_tokens=0, chunk=None,
-            emitted=emitted, occupancy=occupancy,
-            queue_depth=queue_depth,
+        return _InflightTick(
+            toks=toks, rows=rows, plan_ms=plan_ms,
+            dispatch_ms=(time.perf_counter() - t0) * 1e3,
+            n_dec=n_dec, fed_tokens=0, chunk=None,
         )
 
-    def _complete(self, slot: int, reason: str):
+    def _complete(self, slot: int, reason: str,
+                  defer: Optional[list] = None):
+        """Free a finished slot NOW (blocks released, row parked, the
+        scheduler's head-of-line short-circuit invalidated — the next
+        plan/admit must see the capacity), and notify the consumer —
+        immediately, or queued behind the row's deferred tokens when
+        the pipelined spec loop is emitting after the next dispatch."""
         st = self._slots[slot]
         req = st.req
         req.done_t = time.monotonic()
+        if self.paged:
+            self._release_blocks(st)
+            # copy-and-rebind: park the freed row on the trash block
+            tables = self._block_tables.copy()
+            tables[slot, :] = 0
+            self._block_tables = tables
+            lens = self._seq_lens.copy()
+            lens[slot] = 0
+            self._seq_lens = lens
+        self._slots[slot] = None
+        self.requests_completed += 1
+        # freed capacity (slot, blocks, prefix registrations) may make
+        # the queue head admissible again — drop the scheduler's
+        # head-blocked short-circuit
+        self.scheduler.note_capacity_change()
+        if defer is None:
+            self._notify_finish(req, reason, slot)
+        else:
+            defer.append(("finish", req, reason, slot))
+
+    def _notify_finish(self, req: Request, reason: str, slot: int):
         # spans first, then the stream-end sentinel: a client that saw
         # "done" can immediately trace_dump and find the full chain
         decode_t0 = req.prefill_done_t or req.submit_t
@@ -2260,17 +2545,6 @@ class ServingEngine:
         )
         self._m_requests.labels(reason=reason).inc()
         req.stream._finish(reason)
-        if self.paged:
-            self._release_blocks(st)
-            # copy-and-rebind: park the freed row on the trash block
-            tables = self._block_tables.copy()
-            tables[slot, :] = 0
-            self._block_tables = tables
-            lens = self._seq_lens.copy()
-            lens[slot] = 0
-            self._seq_lens = lens
-        self._slots[slot] = None
-        self.requests_completed += 1
         self.metrics.summary(
             "request", rid=req.rid, reason=reason, tokens=req.n_emitted,
             ttft_ms=round((req.first_token_t - req.submit_t) * 1e3, 3),
@@ -2346,7 +2620,10 @@ class ServingEngine:
                      chunk: Optional[int], emitted: int, occupancy: int,
                      queue_depth: int,
                      draft_tokens: Optional[int] = None,
-                     accepted_tokens: Optional[int] = None):
+                     accepted_tokens: Optional[int] = None,
+                     device_wait_ms: Optional[float] = None,
+                     dispatch_ms: Optional[float] = None,
+                     overrun: int = 0):
         """Post-tick runtime introspection + the flight snapshot. The
         whole call is self-timed against tick wall time —
         ``stats()["flight"]["overhead_frac"]`` is that ratio, and
@@ -2387,6 +2664,18 @@ class ServingEngine:
                 "slots": self._slot_snaps(),
                 "recompiles": rec_total,
             }
+            if device_wait_ms is not None:
+                # overlap decomposition: device_ms = dispatch_ms (host
+                # side of the jitted call) + device_wait_ms (time
+                # BLOCKED on readback — what pipelining exists to
+                # shrink); pipeline_depth is the ticks still in flight
+                # after this reconcile, overrun the dropped late-finish
+                # tokens
+                snap["device_wait_ms"] = device_wait_ms
+                snap["dispatch_ms"] = dispatch_ms
+            if self.pipeline:
+                snap["pipeline_depth"] = len(self._pending)
+                snap["overrun_tokens"] = overrun
             if draft_tokens is not None:
                 # speculative ticks: proposals entering this tick's
                 # verify windows and how many survived rejection
@@ -2443,6 +2732,16 @@ class ServingEngine:
             "memory": self._mem.summary(),
             # tensor-parallel degree of the tick bodies (1 = single-chip)
             "tp": self.tp,
+            # pipelined loop: whether dispatch runs ahead of readback,
+            # how long the host actually blocked on the device per tick
+            # (the overlap residue), and how many optimistic tokens
+            # were dropped at reconciliation (late finishes)
+            "pipeline": self.pipeline,
+            "device_wait_ms": {
+                "p50": self._m_device_wait.percentile(50),
+                "p99": self._m_device_wait.percentile(99),
+            },
+            "overrun_tokens": self.overrun_tokens,
         }
         if self.spec:
             out.update({

@@ -191,6 +191,19 @@ class FIFOScheduler:
         self.max_prefills_per_tick = max_prefills_per_tick
         self._q: deque = deque()
         self._lock = threading.Lock()
+        # incremental head bookkeeping: the head request's submit time
+        # is cached at every queue mutation so oldest_age_s never
+        # touches the deque, and a head that failed the engine's
+        # admissible() gate on consecutive pops is short-circuited
+        # (the gate re-runs radix matching + pool arithmetic — pure
+        # waste while nothing was freed). _cap_epoch invalidates the
+        # short-circuit: the engine bumps it whenever capacity is
+        # released (note_capacity_change).
+        self._head_submit_t: Optional[float] = None
+        self._cap_epoch = 0
+        # (head request, consecutive inadmissible pops, epoch observed)
+        self._blocked: Optional[tuple] = None
+        self.head_blocked_skips = 0  # pops answered by the short-circuit
         self.tracer = tracer or telemetry.get_tracer()
         self.registry = registry or telemetry.get_registry()
         self._wire_metrics()
@@ -233,6 +246,8 @@ class FIFOScheduler:
             req.submit_t = time.monotonic()
             self._q.append(req)
             depth = len(self._q)
+            if depth == 1:
+                self._head_submit_t = req.submit_t
         self._m_submitted.inc()
         self._m_depth.set(depth)
         return req
@@ -246,11 +261,23 @@ class FIFOScheduler:
         0.0 when the queue is empty. The admission-latency SLO signal:
         queue *depth* looks fine while one stuck head request starves —
         its age does not. The engine publishes this per tick as the
-        ``serving_queue_oldest_wait_s`` gauge and in flight snapshots."""
+        ``serving_queue_oldest_wait_s`` gauge and in flight snapshots.
+        Reads the incrementally maintained head timestamp — no deque
+        access on the per-tick path."""
         with self._lock:
-            if not self._q:
-                return 0.0
-            return max(time.monotonic() - self._q[0].submit_t, 0.0)
+            head_t = self._head_submit_t
+        if head_t is None:
+            return 0.0
+        return max(time.monotonic() - head_t, 0.0)
+
+    def note_capacity_change(self):
+        """Engine hook: a slot was freed, blocks were released, or a
+        prefix was registered — anything that could turn yesterday's
+        inadmissible head request admissible. Invalidates
+        :meth:`pop_admissible`'s head-of-line short-circuit so the
+        resource gate is re-evaluated on the next pop."""
+        with self._lock:
+            self._cap_epoch += 1
 
     def pop_admissible(
         self, free_slots: int,
@@ -265,7 +292,13 @@ class FIFOScheduler:
         resource gate (the paged engine's free-block check): when the
         HEAD request fails it, popping stops — FIFO order is preserved
         (no queue-jumping past a request that is merely waiting for
-        blocks), and the head retries next step. Returns ``(admitted,
+        blocks), and the head retries next step. A head that failed
+        the gate on each of the last TWO pops with no intervening
+        :meth:`note_capacity_change` is short-circuited: the gate
+        (radix matching + pool arithmetic on the paged engine) is not
+        re-run, because nothing that could change its answer has
+        happened — deadline expiry still runs, so a stuck head can
+        never outlive its deadline silently. Returns ``(admitted,
         expired)``; expired requests are already finished here — span
         chain (``queued`` → ``finish`` with ``reason="expired"``),
         finish-reason counter, and the stream's end sentinel — so they
@@ -277,16 +310,45 @@ class FIFOScheduler:
             budget = min(budget, self.max_prefills_per_tick)
         now = time.monotonic()
         with self._lock:
-            while self._q and len(admitted) < budget:
+            # expiry sweep first: the short-circuit must never keep a
+            # deadline-passed head queued
+            while self._q:
                 req = self._q[0]
                 if (req.deadline_s is not None
                         and now - req.submit_t > req.deadline_s):
                     expired.append(self._q.popleft())
                     continue
-                if admissible is not None and not admissible(req):
-                    break
-                admitted.append(self._q.popleft())
+                break
+            blocked = self._blocked
+            if blocked is not None and (
+                    not self._q or blocked[0] is not self._q[0]):
+                # the blocked head moved on (admitted elsewhere is
+                # impossible FIFO, but it can expire) — drop the state
+                self._blocked = blocked = None
+            if (admissible is not None and blocked is not None
+                    and blocked[1] >= 2
+                    and blocked[2] == self._cap_epoch):
+                # head inadmissible two pops running and no capacity
+                # released since: same inputs, same "no" — skip the scan
+                self.head_blocked_skips += 1
+            else:
+                while self._q and len(admitted) < budget:
+                    req = self._q[0]
+                    if (req.deadline_s is not None
+                            and now - req.submit_t > req.deadline_s):
+                        expired.append(self._q.popleft())
+                        continue
+                    if admissible is not None and not admissible(req):
+                        streak = (blocked[1] + 1 if blocked is not None
+                                  and blocked[0] is req else 1)
+                        self._blocked = (req, streak, self._cap_epoch)
+                        break
+                    admitted.append(self._q.popleft())
+                    if blocked is not None and blocked[0] is req:
+                        self._blocked = blocked = None
             depth = len(self._q)
+            self._head_submit_t = (self._q[0].submit_t if self._q
+                                   else None)
         for req in expired:
             self._expire(req)
         if admitted or expired:

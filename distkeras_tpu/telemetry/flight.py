@@ -91,6 +91,25 @@ class FlightRecorder:
         with self._lock:
             return len(self._buf)
 
+    def percentile(self, key: str, q: float,
+                   kind: str = "tick") -> Optional[float]:
+        """Exact percentile of a numeric snapshot field across the
+        retained ring (``q`` in [0, 100]); None when no retained
+        snapshot of ``kind`` carries ``key``. This is how the pipeline
+        benches and tests assert overlap claims — e.g. steady-state
+        ``device_wait_ms`` p50 must drop under ``pipeline=True`` —
+        without exporting the ring through a registry histogram's
+        bucket interpolation."""
+        vals = sorted(
+            float(s[key]) for s in self.snapshots()
+            if s.get("kind") == kind and isinstance(s.get(key),
+                                                    (int, float))
+        )
+        if not vals:
+            return None
+        idx = min(int(q / 100.0 * len(vals)), len(vals) - 1)
+        return vals[idx]
+
     def clear(self):
         with self._lock:
             self._buf.clear()

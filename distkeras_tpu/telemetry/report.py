@@ -218,9 +218,15 @@ def report_flight(path: str, last: Optional[int] = None,
         cells = (" ".join(_slot_cell(s) for s in slots)
                  if slots is not None else "")
         extra = ""
+        if "device_wait_ms" in r:
+            # pipelined engines: how long the host actually blocked on
+            # readback (device_ms minus what overlap hid)
+            extra += f"  wait={float(r['device_wait_ms']):.2f}"
+        if r.get("overrun_tokens"):
+            extra += f"  overrun={r['overrun_tokens']}"
         if "blocks" in r:
             b = r["blocks"]
-            extra = f"  blocks={b.get('in_use')}/{b.get('free')}free"
+            extra += f"  blocks={b.get('in_use')}/{b.get('free')}free"
         if "draft_tokens" in r:
             # speculative tick: accepted/proposed draft tokens
             extra += (f"  spec={r.get('accepted_tokens')}"
@@ -253,6 +259,21 @@ def report_flight(path: str, last: Optional[int] = None,
         f"p90 {_percentile(tick_ms, 90):.2f}  "
         f"p99 {_percentile(tick_ms, 99):.2f}  max {max(tick_ms):.2f}\n"
     )
+    waits = [float(r["device_wait_ms"]) for r in ticks
+             if "device_wait_ms" in r]
+    if waits:
+        # pipelined engines: the readback block the overlap could not
+        # hide, the in-flight depth, and dropped late-finish tokens
+        overrun = sum(int(r.get("overrun_tokens", 0)) for r in ticks)
+        depth = [r["pipeline_depth"] for r in ticks
+                 if "pipeline_depth" in r]
+        out.write(
+            f"device_wait_ms: p50 {_percentile(waits, 50):.2f}  "
+            f"p90 {_percentile(waits, 90):.2f}  max {max(waits):.2f}"
+            + (f"  pipeline_depth max {max(depth)}  "
+               f"overrun_tokens {overrun}" if depth else "")
+            + "\n"
+        )
     worst = sorted(ticks, key=lambda r: float(r.get("tick_ms", 0.0)),
                    reverse=True)[:slow]
     out.write("slowest ticks: " + ", ".join(

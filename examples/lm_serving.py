@@ -117,6 +117,11 @@ def main():
     ap.add_argument("--spec-k", type=int, default=4,
                     help="draft tokens proposed per row per tick "
                          "(default 4)")
+    ap.add_argument("--pipeline", action="store_true",
+                    help="pipelined engine loop: dispatch tick N+1 "
+                         "before reading tick N's tokens (host "
+                         "planning + streaming overlap device "
+                         "compute; streams stay bit-identical)")
     ap.add_argument("--replicas", type=int, default=1,
                     help="serve through the multi-replica fabric: this "
                          "many in-process LMServer replicas behind the "
@@ -154,6 +159,10 @@ def main():
         ]
 
     engine_kw = {}
+    if args.pipeline:
+        engine_kw["pipeline"] = True
+        print("pipelined engine loop: depth-2 (plan/stream tick N "
+              "overlaps device compute of tick N+1)")
     if args.prefill_chunk is not None:
         engine_kw["prefill_chunk"] = (None if args.prefill_chunk == 0
                                       else args.prefill_chunk)
@@ -285,6 +294,13 @@ def main():
                 f"{total} tokens in {stats['ticks']} ticks "
                 f"(mean occupancy {stats['mean_occupancy']}, "
                 f"ttft p50 {stats['ttft_ms']['p50']:.1f}ms)"
+            )
+        if args.pipeline:
+            dw = stats.get("device_wait_ms", {}).get("p50")
+            print(
+                f"pipeline: {stats.get('overrun_tokens', 0)} overrun "
+                f"tokens dropped at reconciliation, device-wait p50 "
+                + (f"{dw:.2f}ms" if dw is not None else "n/a")
             )
         if args.draft is not None:
             rate = (stats["accepted_tokens"] / stats["draft_tokens"]

@@ -691,8 +691,94 @@ def test_donation_pass_catches_seeded_engine_violation(tmp_path):
     p.write_text(seeded)
     findings = analyze([str(p)])
     assert any(f.rule == "donation-safety"
-               and f.key == "_decode_tick.self._cache"
+               and f.key == "_plan_dispatch_decode.self._cache"
                for f in findings), [f.render() for f in findings]
+
+
+def test_donation_pass_catches_seeded_inflight_handoff(tmp_path):
+    """The in-flight handoff rule against the REAL engine: seed a
+    pre-donation capture of the cache into the _InflightTick record
+    (which the pipelined loop parks on self._pending) and assert the
+    pass pins it."""
+    eng_path = os.path.join(REPO_ROOT, "distkeras_tpu", "serving",
+                            "engine.py")
+    text = open(eng_path).read()
+    seeded = text.replace(
+        """        t0 = time.perf_counter()
+        plan_ms = (t0 - t_plan0) * 1e3
+        dev = self._upload(packed)
+        if self.paged:
+            tick = _paged_mixed_tick_fn(self._dm_paged, cfgs, C,
+                                        self._ctx)
+        else:
+            tick = _mixed_tick_fn(self._dm_slot, cfgs, C, self._ctx)""",
+        """        t0 = time.perf_counter()
+        plan_ms = (t0 - t_plan0) * 1e3
+        dev = self._upload(packed)
+        leak = _InflightTick(toks=self._cache, rows=rows, plan_ms=0.0,
+                             dispatch_ms=0.0, n_dec=n_dec,
+                             fed_tokens=fed_tokens, chunk=C)
+        self._pending.append(leak)
+        if self.paged:
+            tick = _paged_mixed_tick_fn(self._dm_paged, cfgs, C,
+                                        self._ctx)
+        else:
+            tick = _mixed_tick_fn(self._dm_slot, cfgs, C, self._ctx)""",
+        1,
+    )
+    assert seeded != text, "engine dispatch shape changed; update seed"
+    p = tmp_path / "engine_handoff_seeded.py"
+    p.write_text(seeded)
+    findings = analyze([str(p)])
+    assert any(f.rule == "donation-safety"
+               and f.key == "_plan_dispatch_mixed.self._cache:handoff"
+               for f in findings), [f.render() for f in findings]
+
+
+def test_donation_handoff_fixture_good_and_bad(tmp_path):
+    """Unit fixtures for the handoff rule: capturing a tick OUTPUT into
+    an escaping record is fine; capturing a donated INPUT is not."""
+    bad = """
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def tick(buf, x):
+            return buf + x, x
+
+        class Engine:
+            def step(self, x):
+                rec = dict(held=self.buf)
+                self.pending.append(rec)
+                self.buf, toks = tick(self.buf, x)
+                return toks
+    """
+    good = """
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def tick(buf, x):
+            return buf + x, x
+
+        class Engine:
+            def step(self, x):
+                self.buf, toks = tick(self.buf, x)
+                rec = dict(held=toks)
+                self.pending.append(rec)
+                return toks
+    """
+    import textwrap
+
+    pb = tmp_path / "bad_handoff.py"
+    pb.write_text(textwrap.dedent(bad))
+    pg = tmp_path / "good_handoff.py"
+    pg.write_text(textwrap.dedent(good))
+    findings = analyze([str(pb)])
+    assert any(f.rule == "donation-safety" and f.key.endswith(":handoff")
+               for f in findings), [f.render() for f in findings]
+    assert not [f for f in analyze([str(pg)])
+                if f.key.endswith(":handoff")]
 
 
 def test_rng_pass_catches_seeded_engine_violation(tmp_path):
