@@ -1144,12 +1144,22 @@ def bench_router(V=512, D=256, H=4, L=2, replicas=3, slots=2,
     bit-identical to solo ``generate()`` (replay-with-skip on the
     survivors) with zero requests reported failed.
 
+    The measured fleet pass also exercises fleet-wide tracing: every
+    sampled request must yield ONE complete merged span chain under
+    its propagated trace id (router + replica spans, zero lost spans),
+    the router's per-request archive round trips must cost <5% of the
+    bench window, the ``chrome_trace`` op's Perfetto export must be
+    valid trace-event JSON (saved to
+    ``/tmp/distkeras-router-chrome-trace.json`` for the CI artifact),
+    and the critical-path phase sums must reconcile with the
+    client-observed latency.
+
     ``--smoke`` self-asserts all of the above (≥2.4× scaling, affine
     hit fraction within 10% of the reference, random measurably worse,
     zero lost streams, zero steady-state recompiles in the measured
-    fleet pass). Needs ``replicas`` local devices — run via
-    :func:`run_router`, which forces virtual host devices when the
-    process is short (CPU CI)."""
+    fleet pass, plus the tracing contract). Needs ``replicas`` local
+    devices — run via :func:`run_router`, which forces virtual host
+    devices when the process is short (CPU CI)."""
     from distkeras_tpu import telemetry
     from distkeras_tpu.models import get_model
     from distkeras_tpu.models.transformer import generate
@@ -1204,7 +1214,10 @@ def bench_router(V=512, D=256, H=4, L=2, replicas=3, slots=2,
                 block_size=block_size, num_blocks=pool_blocks,
                 prefill_chunk=prefill_chunk,
                 registry=telemetry.MetricRegistry(),
-                tracer=telemetry.Tracer(),
+                # distinct tracer process identities: in-process
+                # replicas stand in for replica processes, so merged
+                # chains / Chrome exports get one lane per replica
+                tracer=telemetry.Tracer(pid=1000 + i),
                 device=devices[i % len(devices)],
             )
             servers.append(LMServer(eng).start())
@@ -1228,7 +1241,8 @@ def bench_router(V=512, D=256, H=4, L=2, replicas=3, slots=2,
         for s in servers:
             s.engine.mark_steady()
 
-    def run_routed(n_replicas, policy, pool_blocks):
+    def run_routed(n_replicas, policy, pool_blocks,
+                   verify_traces=False):
         servers = start_fleet(n_replicas, pool_blocks)
         warm_and_mark(servers)
         router = Router(
@@ -1236,7 +1250,7 @@ def bench_router(V=512, D=256, H=4, L=2, replicas=3, slots=2,
              for i, s in enumerate(servers)],
             policy=policy, block_size=block_size, poll_interval=0.1,
             registry=telemetry.MetricRegistry(),
-            tracer=telemetry.Tracer(),
+            tracer=telemetry.Tracer(pid=1),
         ).start()
         client = ServingClient("127.0.0.1", router.port,
                                request_timeout=300.0)
@@ -1245,6 +1259,8 @@ def bench_router(V=512, D=256, H=4, L=2, replicas=3, slots=2,
         lock = threading.Lock()
         nxt = [0]
         streams: dict = {}
+        traces: dict = {}
+        lats: dict = {}
 
         def worker():
             while True:
@@ -1253,10 +1269,14 @@ def bench_router(V=512, D=256, H=4, L=2, replicas=3, slots=2,
                         return
                     i = nxt[0]
                     nxt[0] += 1
+                t_req = time.perf_counter()
                 rid = client.generate(prompts[i], max_new_tokens=max_new)
                 toks, reason = client.result(rid, timeout=300)
+                lat_ms = (time.perf_counter() - t_req) * 1e3
                 with lock:
                     streams[i] = (toks, reason)
+                    traces[i] = client.trace_of(rid)
+                    lats[i] = lat_ms
 
         t0 = time.perf_counter()
         threads = [threading.Thread(target=worker, daemon=True)
@@ -1283,11 +1303,68 @@ def bench_router(V=512, D=256, H=4, L=2, replicas=3, slots=2,
             "streams": streams,
             "prompts": prompts,
         }
+        if verify_traces:
+            out["trace"] = _verify_traces(client, st, traces, lats, dt)
         client.close()
         router.stop()
         for s in servers:
             s.stop()
         return out
+
+    def _verify_traces(client, st, traces, lats, dt):
+        """Fleet-tracing acceptance, measured on the live fleet: every
+        sampled request yields ONE complete merged chain under its
+        propagated id (zero lost spans), the archive's per-request
+        round trips cost <5% of the bench window, the chrome_trace op
+        exports valid trace-event JSON (saved for the CI artifact),
+        and the critical-path phase sums reconcile with the
+        client-observed latency."""
+        required = {"router.route", "router.stream", "queued",
+                    "prefill", "decode", "finish", "stream"}
+        sample = sorted(traces)[:16]
+        lost = 0
+        for i in sample:
+            chain = client.trace_dump(trace=traces[i])
+            names = {s["span"] for s in chain}
+            ids = {s["trace"] for s in chain}
+            if not required <= names or ids != {traces[i]}:
+                lost += 1
+        # critical path vs the client's own stopwatch, on the slowest
+        # sampled request (largest denominator): phase sums must
+        # reconcile within 5%, floored at 25 ms of wire/ack overhead a
+        # sub-100ms CPU request cannot amortize
+        slow = max(sample, key=lambda i: lats[i])
+        cp = telemetry.critical_path(
+            client.trace_dump(trace=traces[slow]))
+        phase_sum = sum(cp["phases"].values()) if cp else None
+        cp_ok = (phase_sum is not None
+                 and abs(phase_sum - lats[slow])
+                 <= max(0.05 * lats[slow], 25.0))
+        doc = client.chrome_trace(trace=traces[slow])
+        events = doc["traceEvents"]
+        invalid = [e for e in events
+                   if not all(k in e for k in ("ph", "ts", "pid", "tid"))]
+        s_ids = {e["id"] for e in events if e.get("ph") == "s"}
+        f_ids = {e["id"] for e in events if e.get("ph") == "f"}
+        with open("/tmp/distkeras-router-chrome-trace.json", "w") as fh:
+            json.dump(doc, fh)
+        arch = st["router"]["trace_archive"]
+        return {
+            "n_traced": len(sample),
+            "lost_spans": lost,
+            "archived": arch["archived"],
+            "archive_errors": arch["errors"],
+            # archive round trips relative to the measured window —
+            # the tracing-overhead bound the smoke asserts
+            "overhead_frac": round(
+                (arch["ms_total"] / 1e3) / max(dt, 1e-9), 4),
+            "critical_path": cp,
+            "client_ms": round(lats[slow], 1),
+            "critical_path_reconciles": cp_ok,
+            "chrome_events": len(events),
+            "chrome_invalid": len(invalid),
+            "chrome_flows_paired": bool(s_ids) and s_ids == f_ids,
+        }
 
     def run_failover():
         servers = start_fleet(replicas, num_blocks)
@@ -1343,7 +1420,8 @@ def bench_router(V=512, D=256, H=4, L=2, replicas=3, slots=2,
                 pass
         return out
 
-    fleet = run_routed(replicas, "affine", num_blocks)
+    fleet = run_routed(replicas, "affine", num_blocks,
+                       verify_traces=True)
     single = run_routed(1, "affine", num_blocks)
     rand = run_routed(replicas, "random", num_blocks)
     # hit-fraction reference: ONE replica with the fleet's aggregate
@@ -1380,6 +1458,7 @@ def bench_router(V=512, D=256, H=4, L=2, replicas=3, slots=2,
         "failover_inflight_on_victim": failover["inflight_on_victim"],
         "failover_failed": failover["failed"],
         "fleet_steady_recompiles": fleet["steady_recompiles"],
+        "fleet_trace": fleet.get("trace"),
         "n_devices": len(jax.devices()),
         "backend": jax.default_backend(),
         "config": f"d{D}/h{H}/L{L}/v{V}-replicas{replicas}x{slots}slots"
@@ -1403,6 +1482,19 @@ def bench_router(V=512, D=256, H=4, L=2, replicas=3, slots=2,
         assert result["failover_failed"] == 0, result
         assert result["failover_failed_over"] >= 1, result
         assert result["fleet_steady_recompiles"] == {}, result
+        # fleet tracing (ISSUE 11 acceptance): one complete merged
+        # chain per request (zero lost spans), archive+export overhead
+        # under 5% of the bench window (alongside the per-replica
+        # flight-overhead bound the engines already self-assert),
+        # Perfetto-valid export with paired flow arrows, and
+        # critical-path sums reconciling with client latency
+        tr = result["fleet_trace"]
+        assert tr["lost_spans"] == 0, result
+        assert tr["archive_errors"] == 0, result
+        assert tr["overhead_frac"] < 0.05, result
+        assert tr["chrome_invalid"] == 0, result
+        assert tr["chrome_flows_paired"], result
+        assert tr["critical_path_reconciles"], result
     for k in ("streams", "prompts"):
         fleet.pop(k, None)
     print(json.dumps(result), flush=True)

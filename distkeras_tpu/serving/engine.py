@@ -1468,14 +1468,34 @@ class ServingEngine:
             "serving_accept_len",
             "accepted draft prefix length per speculating row per tick",
             buckets=(0, 1, 2, 3, 4, 6, 8, 12, 16))
+        # per-request critical-path attribution (PR 11): where each
+        # finished request's wall time went. The engine observes the
+        # phases it can see (queue wait, prefill, decode host side,
+        # device compute share); the TCP pump adds the post-decode
+        # delivery tail as phase="stream" and the router its routing
+        # overhead as phase="router" — one family, one label
+        self._m_critical = reg.histogram(
+            "serving_request_critical_path_ms",
+            "per-request time attribution by critical-path phase (ms)",
+            labelnames=("phase",))
+        self._m_cp = {ph: self._m_critical.labels(phase=ph)
+                      for ph in ("queue", "prefill", "decode", "device")}
 
     # -- submission ---------------------------------------------------------
 
     def submit(self, prompt, max_new_tokens: int, temperature: float = 0.0,
                seed: int = 0, eos_id: Optional[int] = None,
                top_k: Optional[int] = None, top_p: Optional[float] = None,
-               deadline_s: Optional[float] = None) -> Request:
+               deadline_s: Optional[float] = None,
+               trace_id: Optional[int] = None,
+               parent_span: Optional[str] = None) -> Request:
         """Queue one request; returns it (consume ``request.stream``).
+        ``trace_id`` joins the request to an upstream-propagated
+        telemetry trace (the TCP front-end forwards the wire ``trace``
+        field here, so one id follows a request across processes);
+        omitted, the scheduler mints a fresh fleet-unique id.
+        ``parent_span`` names the upstream span that submitted this
+        request (stamped on the queued span as the cross-process link).
         Raises :class:`QueueFullError` under backpressure,
         :class:`DrainingError` after :meth:`begin_drain`, and
         ``ValueError`` for requests that can never fit the cache."""
@@ -1507,6 +1527,7 @@ class ServingEngine:
             prompt=prompt, max_new_tokens=max_new_tokens,
             temperature=temperature, seed=seed, eos_id=eos_id,
             top_k=top_k, top_p=top_p, deadline_s=deadline_s,
+            trace_id=trace_id, parent_span=parent_span,
         )
         return self.scheduler.submit(req)
 
@@ -1753,8 +1774,10 @@ class ServingEngine:
 
     def _prefill_into(self, slot: int, req: Request):
         now = time.monotonic()
+        req.admit_t = now
         self.tracer.record(req.trace_id, "queued", req.submit_t,
-                           (now - req.submit_t) * 1e3)
+                           (now - req.submit_t) * 1e3,
+                           parent=req.parent_span)
         if self.prefill_chunk is not None:
             self._chunked_enter(slot, req, now)
             return
@@ -2533,16 +2556,27 @@ class ServingEngine:
         # spans first, then the stream-end sentinel: a client that saw
         # "done" can immediately trace_dump and find the full chain
         decode_t0 = req.prefill_done_t or req.submit_t
+        decode_ms = (req.done_t - decode_t0) * 1e3
+        device_ms = min(req.device_ms_accum, decode_ms)
         self.tracer.record(
-            req.trace_id, "decode", decode_t0,
-            (req.done_t - decode_t0) * 1e3,
+            req.trace_id, "decode", decode_t0, decode_ms,
             slot=slot, tokens=req.n_emitted,
+            device_ms=round(device_ms, 3),
         )
         self.tracer.record(
             req.trace_id, "finish", req.done_t, 0.0,
             reason=reason, slot=slot, tokens=req.n_emitted,
             ttft_ms=round((req.first_token_t - req.submit_t) * 1e3, 3),
         )
+        # critical-path attribution: the engine-visible phases of this
+        # request's wall time (the stream tail and router overhead are
+        # observed by the TCP pump / router into the same family)
+        admit_t = req.admit_t or req.submit_t
+        prefill_done = req.prefill_done_t or admit_t
+        self._m_cp["queue"].observe((admit_t - req.submit_t) * 1e3)
+        self._m_cp["prefill"].observe((prefill_done - admit_t) * 1e3)
+        self._m_cp["device"].observe(device_ms)
+        self._m_cp["decode"].observe(max(decode_ms - device_ms, 0.0))
         self._m_requests.labels(reason=reason).inc()
         req.stream._finish(reason)
         self.metrics.summary(
@@ -2644,6 +2678,17 @@ class ServingEngine:
             self._m_oldest_wait.set(round(oldest, 3))
         else:
             mem = None
+        # device-compute attribution: split this tick's device time
+        # evenly over the rows that were active — summed per request
+        # into the critical-path "device" phase (a finished row freed
+        # earlier in this step misses its final share; attribution,
+        # not accounting)
+        if device_ms > 0.0:
+            live = [st for st in self._slots if st is not None]
+            if live:
+                share = device_ms / len(live)
+                for st in live:
+                    st.req.device_ms_accum += share
         t0 = time.perf_counter_ns()
         if self.flight is not None:
             # one flat dict, no rounding: this runs every tick and the
@@ -2742,6 +2787,15 @@ class ServingEngine:
                 "p99": self._m_device_wait.percentile(99),
             },
             "overrun_tokens": self.overrun_tokens,
+            # engine-side critical-path phases (the stream tail and
+            # router overhead land in the same histogram family from
+            # the TCP pump / router; one merged chain's exact breakdown
+            # is `report --trace <id>` / telemetry.critical_path)
+            "critical_path_ms": {
+                ph: {"p50": self._m_critical.percentile(50, phase=ph),
+                     "p99": self._m_critical.percentile(99, phase=ph)}
+                for ph in ("queue", "prefill", "decode", "device")
+            },
         }
         if self.spec:
             out.update({

@@ -373,6 +373,26 @@ class ReplicaManager:
                 continue
         return merge_metric_snapshots(snaps)
 
+    def collect_trace(self, trace: int) -> List[List[dict]]:
+        """``trace_dump(trace)`` from every routable replica — the
+        fan-out leg of fleet trace collection. One propagated trace id
+        names spans on the router AND whichever replicas served (or
+        replayed) the request; the router merges these chains with its
+        own spans via
+        :func:`~distkeras_tpu.telemetry.merge_span_chains`. A replica
+        that fails the fetch is skipped (its spans may still be in the
+        router's :class:`~distkeras_tpu.telemetry.TraceArchive`)."""
+        out: List[List[dict]] = []
+        for r in self.routable():
+            client = r.client
+            if client is None:
+                continue
+            try:
+                out.append(client.trace_dump(trace=trace))
+            except Exception:
+                continue
+        return out
+
     def aggregate_alerts(self) -> List[dict]:
         """Every routable replica's SLO alerts, tagged with the replica
         name (firing state is per-replica; the router adds no rules of

@@ -60,6 +60,22 @@ per-replica health/load gauges live in the router's registry; the
 section, ``metrics`` merges every replica's registry snapshot with the
 router's own, and ``alerts`` concatenates per-replica SLO alerts
 tagged by replica.
+
+Distributed tracing: the router mints ONE fleet-unique trace id per
+request (or honors one the client propagated) and forwards it on every
+backend submit — the replica's ``queued → prefill → decode → finish``
+spans join the router's ``router.route``/``router.stream`` spans under
+the same id, across processes, including failover replays (the replay
+keeps the original id; ``router.failover`` is the link span). The
+``trace_dump`` op with a ``trace`` field *fans out* to the replicas and
+answers the **merged** chain; at stream end each request's merged chain
+is snapshotted into a bounded
+:class:`~distkeras_tpu.telemetry.TraceArchive`, so chains outlive the
+per-process rings. ``chrome_trace`` exports any chain as Chrome
+trace-event JSON (pid=process, tid=slot/stream, flow arrows across the
+router hop) for ui.perfetto.dev, and the router observes its routing
+overhead into the ``serving_request_critical_path_ms{phase="router"}``
+histogram the replicas fill their phases into.
 """
 
 from __future__ import annotations
@@ -75,6 +91,8 @@ from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from distkeras_tpu import telemetry
 from distkeras_tpu.networking import recv_msg, send_msg
+from distkeras_tpu.telemetry.chrome import to_chrome_trace
+from distkeras_tpu.telemetry.trace import merge_span_chains
 from distkeras_tpu.serving.fleet import (
     DOWN,
     DRAINING,
@@ -215,7 +233,7 @@ class _Entry:
 
     __slots__ = ("rid", "conn", "lock", "params", "trace_id", "replica",
                  "client", "backend_rid", "skip", "n_backend",
-                 "delivered", "replays", "aborted", "t0")
+                 "delivered", "replays", "aborted", "t0", "route_ms")
 
     def __init__(self, rid: int, conn, lock, params: dict, trace_id):
         self.rid = rid
@@ -231,6 +249,7 @@ class _Entry:
         self.replays = 0
         self.aborted = False          # client connection gone
         self.t0 = time.monotonic()
+        self.route_ms = 0.0           # time spent routing (incl replays)
 
 
 class Router:
@@ -267,6 +286,12 @@ class Router:
         (acks and inter-token gaps).
       registry / tracer: router-side telemetry sinks (defaults:
         process-global).
+      archive_traces / archive_capacity: snapshot each completed
+        request's fleet-merged span chain into a bounded
+        :class:`~distkeras_tpu.telemetry.TraceArchive` (one backend
+        ``trace_dump`` round trip per completed request, off the
+        stream's critical path; ``archive_traces=False`` disables —
+        ``trace_dump`` then answers only from live rings).
     """
 
     def __init__(self, replicas: Sequence, host: str = "127.0.0.1",
@@ -282,6 +307,8 @@ class Router:
                  max_frame_bytes: int = MAX_SERVE_FRAME_BYTES,
                  registry: Optional[telemetry.MetricRegistry] = None,
                  tracer: Optional[telemetry.Tracer] = None,
+                 archive_traces: bool = True,
+                 archive_capacity: int = 512,
                  seed: int = 0):
         if policy not in ("affine", "hash", "random"):
             raise ValueError(
@@ -359,6 +386,25 @@ class Router:
             "router_inflight_requests",
             "requests currently proxied through the router",
         )
+        # fleet tracing: completed chains archived per request, and the
+        # router's own critical-path phase (routing overhead) in the
+        # same family the replica engines fill
+        self.archive = (telemetry.TraceArchive(archive_capacity)
+                        if archive_traces else None)
+        self._archive_lock = threading.Lock()
+        self._archived = 0
+        self._archive_errors = 0
+        self._archive_ns = 0
+        self._m_archived = self.registry.counter(
+            "router_traces_archived_total",
+            "completed request chains snapshotted into the trace archive",
+        )
+        self._m_critical = self.registry.histogram(
+            "serving_request_critical_path_ms",
+            "per-request time attribution by critical-path phase (ms)",
+            labelnames=("phase",),
+        )
+        self._m_cp_router = self._m_critical.labels(phase="router")
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -472,9 +518,13 @@ class Router:
                 exclude.add(replica.name)
                 continue
             try:
+                # the router's trace id rides the wire: the replica's
+                # span chain joins this request's fleet-wide trace
+                # (failover replays keep the original id too)
                 backend_rid = client.generate(
                     entry.params["prompt"],
                     entry.params["max_new_tokens"],
+                    trace=entry.trace_id, parent_span="router.route",
                     **{k: v for k, v in entry.params.items()
                        if k not in ("prompt", "max_new_tokens")},
                 )
@@ -574,15 +624,27 @@ class Router:
                                delivered=entry.delivered)
             entry.skip = entry.delivered
             try:
+                t_route = time.perf_counter()
                 self._submit_routed(
                     entry,
                     exclude={dead.name} if dead is not None else set(),
                 )
+                entry.route_ms += (time.perf_counter() - t_route) * 1e3
             except Exception:
                 reason = "error"
                 self._m_failed.inc()
                 break
             reason = None
+        # span before the done frame (same discipline as LMServer's
+        # pump): a client that saw "done" can immediately trace_dump
+        # the merged chain and find router.stream in it
+        self.tracer.record(
+            entry.trace_id, "router.stream", entry.t0,
+            (time.monotonic() - entry.t0) * 1e3,
+            tokens=entry.delivered, reason=reason,
+            replays=entry.replays,
+        )
+        self._m_cp_router.observe(entry.route_ms)
         self._send_entry(entry, {
             "id": entry.rid, "done": 1, "reason": reason,
             "n": entry.delivered,
@@ -590,12 +652,36 @@ class Router:
         with self._inflight_lock:
             self._inflight.pop(entry.rid, None)
             self._m_inflight.set(len(self._inflight))
-        self.tracer.record(
-            entry.trace_id, "router.stream", entry.t0,
-            (time.monotonic() - entry.t0) * 1e3,
-            tokens=entry.delivered, reason=reason,
-            replays=entry.replays,
-        )
+        self._archive_chain(entry)
+
+    def _archive_chain(self, entry: _Entry):
+        """Snapshot a completed request's fleet-merged span chain into
+        the bounded archive: the router's own spans plus the serving
+        replica's (one ``trace_dump`` round trip on this pump thread,
+        after the client already has its done frame — never on the
+        stream's critical path). Chains thereby outlive the
+        per-process rings that fed them."""
+        if self.archive is None:
+            return
+        t0 = time.perf_counter_ns()
+        ok = True
+        chains = [self.tracer.dump(trace=entry.trace_id)]
+        client = entry.client
+        if client is not None and not client.closed:
+            try:
+                chains.append(client.trace_dump(trace=entry.trace_id))
+            except Exception:
+                ok = False  # replica died post-stream: archive partial
+        prior = self.archive.get(entry.trace_id)
+        if prior:
+            chains.append(prior)
+        self.archive.put(entry.trace_id, merge_span_chains(*chains))
+        self._m_archived.inc()
+        with self._archive_lock:
+            self._archived += 1
+            if not ok:
+                self._archive_errors += 1
+            self._archive_ns += time.perf_counter_ns() - t0
 
     # -- front-door protocol ------------------------------------------------
 
@@ -643,13 +729,32 @@ class Router:
                             "alerts": self.manager.aggregate_alerts(),
                         })
                     elif op == "trace_dump":
-                        spans = self.tracer.dump(
-                            trace=(None if msg.get("trace") is None
-                                   else int(msg["trace"])),
-                            limit=(None if msg.get("limit") is None
-                                   else int(msg["limit"])),
-                        )
+                        # one trace id -> the FLEET-merged chain (fan
+                        # out to replicas by the propagated id, merge
+                        # with router spans + archive); no id -> the
+                        # router's own recent spans, as before
+                        trace = (None if msg.get("trace") is None
+                                 else int(msg["trace"]))
+                        limit = (None if msg.get("limit") is None
+                                 else int(msg["limit"]))
+                        if trace is not None:
+                            spans = self.merged_trace(trace)
+                            if limit is not None and limit >= 0:
+                                spans = spans[-limit:]
+                        else:
+                            spans = self.tracer.dump(limit=limit)
                         self._send(conn, lock, {"ok": 1, "spans": spans})
+                    elif op == "chrome_trace":
+                        trace = (None if msg.get("trace") is None
+                                 else int(msg["trace"]))
+                        limit = (None if msg.get("limit") is None
+                                 else int(msg["limit"]))
+                        spans = (self.merged_trace(trace)
+                                 if trace is not None
+                                 else self.tracer.dump(limit=limit))
+                        self._send(conn, lock, {
+                            "ok": 1, "chrome": to_chrome_trace(spans),
+                        })
                     elif op == "drain":
                         self._op_drain(conn, lock, msg)
                     elif op == "flight":
@@ -709,9 +814,15 @@ class Router:
                 params[k] = cast(msg[k])
         entry = _Entry(
             rid=next(self._rid_counter), conn=conn, lock=lock,
-            params=params, trace_id=self.tracer.new_trace_id(),
+            params=params,
+            # honor a client-propagated trace id (a tracing frontend
+            # upstream of the router); mint the fleet-wide id otherwise
+            trace_id=(int(msg["trace"]) if msg.get("trace") is not None
+                      else self.tracer.new_trace_id()),
         )
+        t_route = time.perf_counter()
         self._submit_routed(entry, exclude=set())
+        entry.route_ms += (time.perf_counter() - t_route) * 1e3
         with self._inflight_lock:
             self._inflight[entry.rid] = entry
             self._m_inflight.set(len(self._inflight))
@@ -749,6 +860,20 @@ class Router:
 
     # -- aggregated views ---------------------------------------------------
 
+    def merged_trace(self, trace: int) -> List[dict]:
+        """One request's spans merged across the fleet: the router's
+        own ring, the archive snapshot (chains of completed requests
+        outlive the live rings), and a ``trace_dump`` fan-out to every
+        routable replica — deduped and wall-clock ordered into ONE
+        chain by :func:`~distkeras_tpu.telemetry.merge_span_chains`."""
+        chains = [self.tracer.dump(trace=trace)]
+        if self.archive is not None:
+            archived = self.archive.get(trace)
+            if archived:
+                chains.append(archived)
+        chains.extend(self.manager.collect_trace(trace))
+        return merge_span_chains(*chains)
+
     def stats(self) -> dict:
         """Fleet sums at the top level (a client written against one
         LMServer keeps finding ``requests_completed`` etc.), plus the
@@ -779,6 +904,26 @@ class Router:
                 "router_requests_failed_total").value,
             "overload_rejections": self.registry.counter(
                 "router_overload_rejections_total").value,
+            "critical_path_ms": {
+                "router": {
+                    "p50": self._m_critical.percentile(
+                        50, phase="router"),
+                    "p99": self._m_critical.percentile(
+                        99, phase="router"),
+                },
+            },
+        }
+        with self._archive_lock:
+            archived = self._archived
+            errors = self._archive_errors
+            archive_ms = self._archive_ns / 1e6
+        router["trace_archive"] = {
+            "enabled": self.archive is not None,
+            "archived": archived,
+            "errors": errors,
+            "ms_total": round(archive_ms, 3),
+            "chains": (len(self.archive)
+                       if self.archive is not None else 0),
         }
         return {**agg["fleet"], "replicas": agg["replicas"],
                 "router": router}

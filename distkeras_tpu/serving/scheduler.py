@@ -121,16 +121,27 @@ class Request:
     deadline_s: Optional[float] = None
     rid: int = field(default_factory=lambda: next(_rid_counter))
     stream: TokenStream = field(default_factory=TokenStream)
-    # telemetry: allocated by FIFOScheduler.submit, carried end-to-end
-    # (TCP acks return it so clients can query trace_dump)
+    # telemetry: allocated by FIFOScheduler.submit UNLESS the caller
+    # propagated one (the TCP front-end forwards the wire `trace`
+    # field, so a request routed client -> router -> replica keeps ONE
+    # id end-to-end; TCP acks return it so clients can query
+    # trace_dump). `parent_span` names the upstream span that submitted
+    # this request (e.g. "router.route") and is stamped on the queued
+    # span as the cross-process link.
     trace_id: Optional[int] = None
+    parent_span: Optional[str] = None
     # engine bookkeeping (monotonic timestamps)
     submit_t: Optional[float] = None
+    admit_t: Optional[float] = None  # queue exit / slot entry
     first_token_t: Optional[float] = None
     last_token_t: Optional[float] = None  # previous emit (ITL histogram)
     done_t: Optional[float] = None
     prefill_done_t: Optional[float] = None
     n_emitted: int = 0
+    # device compute attributed to this request (per-tick share of
+    # device_ms across the slots active that tick) — the critical-path
+    # "device" phase and the decode span's device_ms attr
+    device_ms_accum: float = 0.0
 
 
 class FIFOScheduler:
@@ -233,7 +244,10 @@ class FIFOScheduler:
     def submit(self, req: Request) -> Request:
         """Enqueue or raise :class:`QueueFullError` (backpressure).
         Allocates the request's trace id — admission is where a request
-        enters the system, so the whole span chain shares this id."""
+        enters the system, so the whole span chain shares this id —
+        UNLESS one was propagated from upstream (a router or remote
+        client already minted the fleet-wide id; spans recorded here
+        join that chain)."""
         if req.trace_id is None:
             req.trace_id = self.tracer.new_trace_id()
         with self._lock:
@@ -413,7 +427,7 @@ class FIFOScheduler:
         req.done_t = time.monotonic()
         queued_ms = (req.done_t - req.submit_t) * 1e3
         self.tracer.record(req.trace_id, "queued", req.submit_t,
-                           queued_ms)
+                           queued_ms, parent=req.parent_span)
         self.tracer.record(req.trace_id, "finish", req.done_t, 0.0,
                            reason="expired", tokens=0)
         self._m_finished.labels(reason="expired").inc()

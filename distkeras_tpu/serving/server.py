@@ -11,10 +11,13 @@ Protocol (all frames are msgpack dicts):
   client → server
     {"op": "generate", "prompt": [ids], "max_new_tokens": n,
      "temperature"?, "seed"?, "eos_id"?, "top_k"?, "top_p"?,
-     "deadline_s"?}
+     "deadline_s"?, "trace"?: tid, "parent_span"?: name}
     {"op": "stats"}
     {"op": "metrics"}                         # registry snapshot
     {"op": "trace_dump", "trace"?: tid, "limit"?: n}
+    {"op": "chrome_trace", "trace"?: tid, "limit"?: n}
+                                              # spans as Chrome
+                                              # trace-event JSON
     {"op": "flight", "last"?: n}              # flight-recorder ticks
     {"op": "alerts"}                          # SLO monitor state
     {"op": "drain"}                           # close admissions (graceful)
@@ -34,14 +37,20 @@ Protocol (all frames are msgpack dicts):
     {"ok": 1, "stats": {...}}                 # stats reply
     {"ok": 1, "metrics": {...}}               # MetricRegistry.collect()
     {"ok": 1, "spans": [...]}                 # Tracer.dump()
+    {"ok": 1, "chrome": {"traceEvents": [...]}}   # Perfetto-loadable
     {"ok": 1, "flight": {"meta":..,"ticks":[..]}}   # FlightRecorder ring
     {"ok": 1, "alerts": [...]}                # SloMonitor.alerts()
     {"ok": 1, "draining": 1, "active": a, "queued": q}   # drain accepted
 
 The ``trace`` id in the generate ack is the request's telemetry trace id
-(allocated at admission): ``trace_dump`` filtered to it returns the full
-span chain (queued/prefill/decode/finish + this connection's stream
-span).
+(allocated at admission, OR propagated verbatim when the submit carried
+a ``trace`` field — how a router keeps one fleet-wide id across the
+client → router → replica hops; ``parent_span`` names the upstream span
+that submitted, recorded on the queued span as the cross-process link):
+``trace_dump`` filtered to it returns the full span chain
+(queued/prefill/decode/finish + this connection's stream span), and
+``chrome_trace`` the same spans as Chrome trace-event JSON for
+ui.perfetto.dev.
 
 Tokens stream as the engine emits them — a connection may hold many
 in-flight requests, so frames are tagged with the request id and the
@@ -60,6 +69,7 @@ from typing import Dict, List, Optional, Tuple
 from distkeras_tpu.networking import connect, recv_msg, send_msg
 from distkeras_tpu.serving.engine import ServingEngine
 from distkeras_tpu.serving.scheduler import DrainingError, QueueFullError
+from distkeras_tpu.telemetry.chrome import to_chrome_trace
 
 # serving frames are small (one token or one prompt); cap accordingly
 MAX_SERVE_FRAME_BYTES = 1 << 24  # 16 MiB
@@ -141,6 +151,14 @@ class LMServer:
         # at stop time, not whenever they next send a frame)
         self._conns: List[socket.socket] = []
         self._conns_lock = threading.Lock()
+        # critical-path "stream" phase: the delivery tail after the
+        # engine finished decoding — observed per request by the pump,
+        # into the same family the engine fills its phases into
+        self._m_cp_stream = engine.registry.histogram(
+            "serving_request_critical_path_ms",
+            "per-request time attribution by critical-path phase (ms)",
+            labelnames=("phase",),
+        ).labels(phase="stream")
 
     def start(self) -> "LMServer":
         self._sock.listen(64)
@@ -209,14 +227,24 @@ class LMServer:
             for tok in req.stream:
                 self._send(conn, lock, {"id": req.rid, "t": int(tok)})
                 n += 1
+            # span before the done frame (same discipline as
+            # _notify_finish): a client that saw "done" can immediately
+            # trace_dump and find the stream span in the chain
+            end = time.monotonic()
+            self.engine.tracer.record(
+                req.trace_id, "stream", t0, (end - t0) * 1e3, tokens=n,
+            )
+            # delivery tail: how long the pump kept running after the
+            # engine finished the request (done_t is set before the
+            # stream's end sentinel, so it is visible here)
+            self._m_cp_stream.observe(
+                max(0.0, (end - req.done_t) * 1e3)
+                if req.done_t is not None else 0.0
+            )
             self._send(conn, lock, {
                 "id": req.rid, "done": 1,
                 "reason": req.stream.finish_reason, "n": n,
             })
-            self.engine.tracer.record(
-                req.trace_id, "stream", t0,
-                (time.monotonic() - t0) * 1e3, tokens=n,
-            )
         except (ConnectionError, OSError):
             # client went away mid-stream: drain silently (the engine
             # finishes the request; its tokens are simply dropped)
@@ -255,6 +283,14 @@ class LMServer:
                             deadline_s=(
                                 None if msg.get("deadline_s") is None
                                 else float(msg["deadline_s"])),
+                            # propagated trace context: a router (or
+                            # tracing client) minted the id upstream —
+                            # this replica's spans join that chain
+                            trace_id=(None if msg.get("trace") is None
+                                      else int(msg["trace"])),
+                            parent_span=(
+                                None if msg.get("parent_span") is None
+                                else str(msg["parent_span"])),
                         )
                         # ack BEFORE the pump starts so the acceptance
                         # frame always precedes the first token frame
@@ -282,6 +318,16 @@ class LMServer:
                                    else int(msg["limit"])),
                         )
                         self._send(conn, lock, {"ok": 1, "spans": spans})
+                    elif op == "chrome_trace":
+                        spans = self.engine.tracer.dump(
+                            trace=(None if msg.get("trace") is None
+                                   else int(msg["trace"])),
+                            limit=(None if msg.get("limit") is None
+                                   else int(msg["limit"])),
+                        )
+                        self._send(conn, lock, {
+                            "ok": 1, "chrome": to_chrome_trace(spans),
+                        })
                     elif op == "flight":
                         fl = self.engine.flight
                         if fl is None:
@@ -492,7 +538,11 @@ class ServingClient:
     def generate(self, prompt, max_new_tokens: int, **kw) -> int:
         """Submit one request; returns its id (stream via
         :meth:`stream` / :meth:`result`; telemetry trace id via
-        :meth:`trace_of`). Typed rejections: :class:`OverloadedError`
+        :meth:`trace_of`). Pass ``trace=`` (and optionally
+        ``parent_span=``) to propagate an existing telemetry trace id
+        across the wire — the server's spans join that chain instead
+        of minting a new id (how the router stitches one fleet-wide
+        trace per request). Typed rejections: :class:`OverloadedError`
         (queue backpressure — retry elsewhere/later),
         :class:`~distkeras_tpu.serving.DrainingError` (admissions
         closed), :class:`ServingConnectionError` (dead connection,
@@ -578,6 +628,18 @@ class ServingClient:
         if limit is not None:
             msg["limit"] = int(limit)
         return list(self._call(msg)["spans"])
+
+    def chrome_trace(self, trace: Optional[int] = None,
+                     limit: Optional[int] = None) -> dict:
+        """Server-side spans as Chrome trace-event JSON (one trace id's
+        chain when given — against a router, the fleet-merged chain).
+        ``json.dump`` the result and open it in ui.perfetto.dev."""
+        msg: dict = {"op": "chrome_trace"}
+        if trace is not None:
+            msg["trace"] = int(trace)
+        if limit is not None:
+            msg["limit"] = int(limit)
+        return dict(self._call(msg)["chrome"])
 
     def flight(self, last: Optional[int] = None) -> dict:
         """The server engine's flight-recorder ring:

@@ -3,9 +3,18 @@
 Five pieces, one import surface:
 
 - :mod:`~distkeras_tpu.telemetry.trace` — per-request span tracing
-  (``Tracer``): trace ids allocated at admission, spans recorded by every
-  subsystem a request crosses, queryable live (``trace_dump`` ops,
+  (``Tracer``): fleet-unique random trace ids propagated across the
+  wire (client → router → replica keep ONE id), spans stamped with a
+  wall-clock anchor so cross-process chains merge
+  (``merge_span_chains``), bounded archives of completed chains
+  (``TraceArchive``), and per-request time attribution
+  (``critical_path``); queryable live (``trace_dump`` ops,
   ``/traces``) or offline (JSONL + the ``report`` CLI).
+- :mod:`~distkeras_tpu.telemetry.chrome` — Chrome trace-event /
+  Perfetto export (``to_chrome_trace``): any span chain as a
+  ``ui.perfetto.dev``-loadable JSON, pid=process, tid=slot/stream,
+  flow arrows across the router hop (``chrome_trace`` ops,
+  ``report --chrome-trace``).
 - :mod:`~distkeras_tpu.telemetry.registry` — Prometheus-style
   counters/gauges/histograms (``MetricRegistry``) that the serving
   engine, scheduler, parameter-server service, and trainers publish
@@ -35,6 +44,11 @@ This package is stdlib-only (no jax import) so instrumentation can never
 perturb device code, and every subsystem can import it without cycles.
 """
 
+from distkeras_tpu.telemetry.chrome import (  # noqa: F401
+    chrome_trace_events,
+    to_chrome_trace,
+    write_chrome_trace,
+)
 from distkeras_tpu.telemetry.exposition import (  # noqa: F401
     TelemetryServer,
     render_prometheus,
@@ -66,8 +80,12 @@ from distkeras_tpu.telemetry.slo import (  # noqa: F401
     default_serving_rules,
 )
 from distkeras_tpu.telemetry.trace import (  # noqa: F401
+    CRITICAL_PATH_PHASES,
+    TraceArchive,
     Tracer,
+    critical_path,
     get_tracer,
+    merge_span_chains,
 )
 
 __all__ = [
@@ -78,6 +96,13 @@ __all__ = [
     "get_registry",
     "Tracer",
     "get_tracer",
+    "TraceArchive",
+    "merge_span_chains",
+    "critical_path",
+    "CRITICAL_PATH_PHASES",
+    "to_chrome_trace",
+    "chrome_trace_events",
+    "write_chrome_trace",
     "TelemetryServer",
     "render_prometheus",
     "FlightRecorder",

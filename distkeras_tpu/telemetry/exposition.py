@@ -14,6 +14,9 @@ Routes:
     /metrics.json   the same snapshot as JSON
     /traces         recent spans as JSON; ?trace=<id> filters one
                     request, ?limit=<n> truncates
+    /chrome         the same spans as Chrome trace-event JSON
+                    (?trace=/?limit= as above) — save and open in
+                    ui.perfetto.dev
     /flight         flight-recorder tick snapshots as JSON
                     ({"meta": ..., "ticks": [...]}); ?last=<n> keeps
                     the most recent n; 404 when no recorder is wired
@@ -30,6 +33,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 from urllib.parse import parse_qs, urlparse
 
+from distkeras_tpu.telemetry.chrome import to_chrome_trace
 from distkeras_tpu.telemetry.registry import MetricRegistry, get_registry
 from distkeras_tpu.telemetry.trace import Tracer, get_tracer
 
@@ -148,6 +152,18 @@ class TelemetryServer:
                             200,
                             json.dumps(outer.tracer.dump(trace=trace,
                                                          limit=limit)),
+                            "application/json",
+                        )
+                    elif url.path == "/chrome":
+                        trace = (int(q["trace"][0])
+                                 if "trace" in q else None)
+                        limit = (int(q["limit"][0])
+                                 if "limit" in q else None)
+                        self._reply(
+                            200,
+                            json.dumps(to_chrome_trace(
+                                outer.tracer.dump(trace=trace,
+                                                  limit=limit))),
                             "application/json",
                         )
                     elif url.path == "/flight":

@@ -3,13 +3,27 @@
     python -m distkeras_tpu.telemetry.report /tmp/trace.jsonl
     python -m distkeras_tpu.telemetry.report /tmp/trace.jsonl --trace 17
     python -m distkeras_tpu.telemetry.report /tmp/trace.jsonl --top 5
+    python -m distkeras_tpu.telemetry.report /tmp/trace.jsonl --chrome-trace out.json
     python -m distkeras_tpu.telemetry.report --flight /tmp/distkeras-postmortem-*.jsonl
 
 Span mode input is what :class:`~distkeras_tpu.telemetry.trace.Tracer`
 mirrors to ``path=`` (or a saved ``trace_dump`` / ``/traces`` response,
-one span per line). Output answers the question the JSONL alone doesn't:
-*where did request N spend its time* — an aligned per-span timeline bar
-per trace, plus per-span-name duration percentiles across all traces.
+one span per line — including a fleet-merged chain saved from the
+router's ``trace_dump``). Output answers the question the JSONL alone
+doesn't: *where did request N spend its time* — an aligned per-span
+timeline bar per trace, plus per-span-name duration percentiles across
+all traces. ``--trace`` additionally prints the critical-path
+breakdown (queue / prefill / decode / device / stream / router).
+
+Chains recorded by more than one process are aligned on each span's
+wall-clock stamp (``w``, derived from the per-tracer anchor pair).
+**Skew tolerance:** cross-host wall clocks agree only to NTP precision,
+so offsets between spans from *different* processes are approximate to
+within a few milliseconds — the renderer notes this on multi-process
+timelines and never infers ordering bugs from sub-ms inversions.
+
+``--chrome-trace OUT`` exports the spans (optionally one ``--trace``)
+as Chrome trace-event JSON — open in ``ui.perfetto.dev``.
 
 ``--flight`` mode renders a
 :class:`~distkeras_tpu.telemetry.flight.FlightRecorder` dump (manual or
@@ -87,30 +101,63 @@ def _percentile(vals: List[float], p: float) -> float:
 def render_timeline(spans: List[dict], trace: int,
                     out: Optional[TextIO] = None):
     """One request's spans as offset-aligned bars (offsets relative to
-    the trace's earliest span start)."""
+    the trace's earliest span start). A chain recorded by more than one
+    process is aligned on the wall-clock stamps (``w``) — noted in the
+    header, because cross-host wall clocks are only NTP-aligned."""
     out = out or sys.stdout
-    mine = sorted(
-        (s for s in spans if s["trace"] == trace), key=lambda s: s["t0"]
-    )
+    mine = [s for s in spans if s["trace"] == trace]
     if not mine:
         out.write(f"trace {trace}: no spans\n")
         return
-    base = mine[0]["t0"]
-    end = max(s["t0"] + s["ms"] / 1e3 for s in mine)
+    # wall-clock alignment only when EVERY span carries the anchor
+    # stamp (mixing epoch-seconds `w` with monotonic `t0` would place
+    # old-format spans billions of seconds apart)
+    use_wall = all("w" in s for s in mine)
+    start = (lambda s: s["w"]) if use_wall else (lambda s: s["t0"])
+    mine = sorted(mine, key=start)
+    pids = {s["pid"] for s in mine if "pid" in s}
+    base = start(mine[0])
+    end = max(start(s) + s["ms"] / 1e3 for s in mine)
     total_ms = max((end - base) * 1e3, 1e-9)
-    out.write(f"trace {trace}  ({total_ms:.1f} ms total)\n")
+    multi = len(pids) > 1
+    out.write(
+        f"trace {trace}  ({total_ms:.1f} ms total)"
+        + (f"  [{len(pids)} processes merged on wall clock; "
+           f"cross-host offsets are NTP-approximate]" if multi else "")
+        + "\n"
+    )
     for s in mine:
-        off_ms = (s["t0"] - base) * 1e3
-        lo = int(off_ms / total_ms * _BAR_WIDTH)
+        off_ms = (start(s) - base) * 1e3
+        lo = min(int(off_ms / total_ms * _BAR_WIDTH), _BAR_WIDTH - 1)
         ln = max(1, int(s["ms"] / total_ms * _BAR_WIDTH))
         bar = " " * lo + "#" * min(ln, _BAR_WIDTH - lo)
         attrs = {k: v for k, v in s.items()
-                 if k not in ("trace", "span", "t0", "ms")}
+                 if k not in ("trace", "span", "t0", "ms", "w", "pid")}
         attr_str = ("  " + " ".join(f"{k}={v}" for k, v in attrs.items())
                     if attrs else "")
+        label = (f"[{s['pid']}] " if multi and "pid" in s else "")
         out.write(
-            f"  {s['span']:<10} {bar:<{_BAR_WIDTH}} "
+            f"  {label}{s['span']:<14} {bar:<{_BAR_WIDTH}} "
             f"+{off_ms:8.1f}ms  {s['ms']:8.1f}ms{attr_str}\n"
+        )
+
+
+def render_critical_path(spans: List[dict], trace: int,
+                         out: Optional[TextIO] = None):
+    """The per-request phase attribution for one trace (where the time
+    actually went): queue / prefill / decode / device / stream /
+    router, from :func:`~distkeras_tpu.telemetry.trace.critical_path`."""
+    from distkeras_tpu.telemetry.trace import critical_path
+
+    out = out or sys.stdout
+    cp = critical_path([s for s in spans if s["trace"] == trace])
+    if cp is None:
+        return
+    total = max(cp["total_ms"], 1e-9)
+    out.write(f"  critical path ({cp['total_ms']:.1f} ms):\n")
+    for phase, ms in cp["phases"].items():
+        out.write(
+            f"    {phase:<8} {ms:>9.1f}ms  {100 * ms / total:5.1f}%\n"
         )
 
 
@@ -147,6 +194,7 @@ def report(path: str, trace: Optional[int] = None, top: int = 10,
         return
     if trace is not None:
         render_timeline(spans, trace, out)
+        render_critical_path(spans, trace, out)
         return
     # longest-total traces first: the ones worth looking at
     totals: Dict[int, float] = defaultdict(float)
@@ -302,6 +350,11 @@ def main(argv=None):
                     help="render only this trace id")
     ap.add_argument("--top", type=int, default=10,
                     help="how many longest traces to render (default 10)")
+    ap.add_argument("--chrome-trace", metavar="OUT", default=None,
+                    help="span mode: export the spans (one trace id "
+                         "with --trace, else all) as Chrome "
+                         "trace-event JSON to OUT — open in "
+                         "ui.perfetto.dev")
     ap.add_argument("--flight", action="store_true",
                     help="input is a flight-recorder dump (postmortem "
                          "or manual): render the tick timeline")
@@ -312,6 +365,22 @@ def main(argv=None):
     try:
         if args.flight:
             report_flight(args.path, last=args.last)
+        elif args.chrome_trace is not None:
+            from distkeras_tpu.telemetry.chrome import write_chrome_trace
+
+            spans = load_spans(args.path)
+            if args.trace is not None:
+                spans = [s for s in spans if s["trace"] == args.trace]
+            try:
+                doc = write_chrome_trace(args.chrome_trace, spans)
+            except OSError as e:
+                raise ReportError(
+                    f"cannot write {args.chrome_trace}: "
+                    f"{e.strerror or e}"
+                ) from None
+            print(f"wrote {len(doc['traceEvents'])} events "
+                  f"({len(spans)} spans) to {args.chrome_trace} — "
+                  f"open in ui.perfetto.dev")
         else:
             report(args.path, trace=args.trace, top=args.top)
     except ReportError as e:

@@ -289,9 +289,27 @@ def test_failover_requeues_unstarted_requests(model_and_params):
                 break
             time.sleep(0.01)
         victim = max(by, key=by.get)
-        time.sleep(0.05)  # let the first stream emit a few tokens
+        # kill on observed PROGRESS, not a fixed sleep: consume the
+        # first stream until its second token, then stop the victim
+        # while that stream is provably mid-flight and the others sit
+        # queued behind the single slot (a fixed sleep races warm
+        # engines — three 30-token streams can finish inside it)
+        frames0 = client.frames(rids[0], timeout=120)
+        toks0 = []
+        for kind, val in frames0:
+            if kind == "tok":
+                toks0.append(val)
+            if len(toks0) >= 2:
+                break
         servers[int(victim[1:])].stop()
-        for p, rid in zip(prompts, rids):
+        for kind, val in frames0:
+            if kind == "tok":
+                toks0.append(val)
+            else:
+                reason0 = val
+        assert toks0 == _solo(model, params, prompts[0], 30)
+        assert reason0 == "length"
+        for p, rid in zip(prompts[1:], rids[1:]):
             toks, reason = client.result(rid, timeout=120)
             assert toks == _solo(model, params, p, 30)
             assert reason == "length"

@@ -1,0 +1,532 @@
+"""Fleet-wide distributed tracing: cross-process trace-id propagation
+(client → router → replica under ONE id, including failover replays),
+random-id collision resistance, wall-clock-anchored cross-process span
+merging, the router's TraceArchive, per-request critical-path
+attribution, and Chrome trace-event (Perfetto) export validity — unit
+level and end-to-end through a 2-replica router fleet."""
+
+import json
+import os
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distkeras_tpu import telemetry
+from distkeras_tpu.models import get_model
+from distkeras_tpu.serving import (
+    LMServer,
+    Router,
+    ServingClient,
+    ServingEngine,
+)
+from distkeras_tpu.telemetry import report as telemetry_report
+from distkeras_tpu.telemetry.chrome import to_chrome_trace
+from distkeras_tpu.telemetry.trace import (
+    TraceArchive,
+    Tracer,
+    critical_path,
+    merge_span_chains,
+)
+
+KW = dict(vocab_size=64, d_model=32, num_heads=2, num_layers=2,
+          max_len=80, dtype=jnp.float32, attention="dense")
+BS = 8
+
+# the span names ONE routed request must leave behind, fleet-wide
+FLEET_CHAIN = {"router.route", "router.stream", "queued", "prefill",
+               "decode", "finish", "stream"}
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    model = get_model("transformer_lm", **KW)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 4), jnp.int32))
+    return model, params
+
+
+def _server(model, params, pid, slots=2):
+    """One replica with its own telemetry sinks and a DISTINCT tracer
+    process identity — in-process replicas stand in for real replica
+    processes, so merged chains and Chrome exports get one lane per
+    replica exactly as a multi-host fleet would."""
+    eng = ServingEngine(
+        model, params, slots=slots,
+        registry=telemetry.MetricRegistry(),
+        tracer=Tracer(pid=pid),
+    )
+    return LMServer(eng).start()
+
+
+def _fleet(model, params, n=2, slots=2, **router_kw):
+    servers = [_server(model, params, pid=1000 + i, slots=slots)
+               for i in range(n)]
+    kw = dict(block_size=BS, poll_interval=0.05, down_after=1,
+              backoff_base=0.05, probe_timeout=2.0,
+              registry=telemetry.MetricRegistry(),
+              tracer=Tracer(pid=1))
+    kw.update(router_kw)
+    router = Router(
+        [("127.0.0.1", s.port, f"r{i}") for i, s in enumerate(servers)],
+        **kw,
+    ).start()
+    return servers, router
+
+
+def _stop(servers, router, clients=()):
+    for c in clients:
+        c.close()
+    router.stop()
+    for s in servers:
+        try:
+            s.stop()
+        except Exception:
+            pass
+
+
+def _assert_chrome_valid(doc, expect_flow=False):
+    """The Chrome-trace contract the smoke + tests share: JSON-clean,
+    every event carries ph/ts/pid/tid, complete events have durations,
+    and flow starts pair up with flow finishes under the same id."""
+    json.loads(json.dumps(doc))  # serializable round trip
+    events = doc["traceEvents"]
+    assert events, "no events exported"
+    for e in events:
+        for k in ("ph", "ts", "pid", "tid"):
+            assert k in e, (k, e)
+        if e["ph"] == "X":
+            assert "dur" in e and e["dur"] >= 0
+    starts = {e["id"] for e in events if e["ph"] == "s"}
+    finishes = {e["id"] for e in events if e["ph"] == "f"}
+    assert starts == finishes, (starts, finishes)
+    if expect_flow:
+        assert starts, "expected flow events for a cross-process chain"
+    names = {e["args"]["name"] for e in events if e["ph"] == "M"}
+    assert any(n.startswith("process") for n in names)
+    return events
+
+
+# ---------------------------------------------------------------------------
+# unit: ids, anchors, merge, archive, critical path, chrome
+# ---------------------------------------------------------------------------
+
+def test_trace_ids_random_and_collision_free_across_processes():
+    """Two tracers standing in for two processes mint 4096 ids each:
+    all distinct within AND across — the property sequential
+    per-process counters lack the moment fleet chains merge."""
+    t1, t2 = Tracer(pid=1), Tracer(pid=2)
+    ids1 = {t1.new_trace_id() for _ in range(4096)}
+    ids2 = {t2.new_trace_id() for _ in range(4096)}
+    assert len(ids1) == 4096 and len(ids2) == 4096
+    assert not ids1 & ids2
+    for tid in list(ids1)[:10]:
+        assert 0 < tid < 2 ** 63  # msgpack/JSON-safe signed 64-bit
+
+
+def test_spans_carry_wall_anchor_and_pid():
+    tr = Tracer(pid=77)
+    tid = tr.new_trace_id()
+    t0 = time.monotonic()
+    tr.record(tid, "work", t0, 1.5, slot=0)
+    (s,) = tr.dump(trace=tid)
+    assert s["pid"] == 77
+    # the wall stamp is the anchor projection of t0, within rounding
+    assert abs(s["w"] - tr.wall_of(t0)) < 1e-5
+    # and sits at the current epoch, not on the monotonic scale
+    assert abs(s["w"] - time.time()) < 60.0
+
+
+def test_merge_orders_cross_process_spans_and_dedupes():
+    """Spans recorded alternately by two tracers merge into true
+    arrival order (wall anchor), and re-merging a chain with itself
+    (live ring + archive both answering) adds nothing."""
+    t1, t2 = Tracer(pid=1), Tracer(pid=2)
+    tid = t1.new_trace_id()
+    order = []
+    for i, tr in enumerate([t1, t2, t1, t2, t1]):
+        name = f"s{i}"
+        tr.record(tid, name, time.monotonic(), 0.1)
+        order.append(name)
+        time.sleep(0.002)  # > wall-clock resolution
+    merged = merge_span_chains(t1.dump(trace=tid), t2.dump(trace=tid))
+    assert [s["span"] for s in merged] == order
+    again = merge_span_chains(merged, t1.dump(trace=tid), merged)
+    assert len(again) == len(merged)
+
+
+def test_trace_archive_bounded_lru():
+    a = TraceArchive(capacity=3)
+    for tid in (1, 2, 3):
+        a.put(tid, [{"trace": tid, "span": "x", "t0": 0.0, "ms": 1.0}])
+    a.put(1, [{"trace": 1, "span": "y", "t0": 0.0, "ms": 1.0}])  # refresh
+    a.put(4, [{"trace": 4, "span": "x", "t0": 0.0, "ms": 1.0}])
+    assert a.get(2) is None          # oldest un-refreshed evicted
+    assert a.get(1)[0]["span"] == "y"
+    assert len(a) == 3 and a.ids() == [3, 1, 4]
+    with pytest.raises(ValueError):
+        TraceArchive(capacity=0)
+
+
+def _synthetic_chain(tid=42):
+    """A hand-built merged chain with exact timings: router window
+    100 ms wrapping queue 10 / prefill 20 / decode 40 (of which device
+    25) / stream tail 5, leaving 25 ms of router overhead."""
+    w = 1000.0
+    return [
+        {"trace": tid, "span": "router.stream", "t0": 0.0, "w": w,
+         "ms": 100.0, "pid": 1, "tokens": 8},
+        {"trace": tid, "span": "router.route", "t0": 0.001, "w": w + 0.001,
+         "ms": 0.0, "pid": 1, "replica": "r0"},
+        {"trace": tid, "span": "queued", "t0": 5.0, "w": w + 0.005,
+         "ms": 10.0, "pid": 2, "parent": "router.route"},
+        {"trace": tid, "span": "prefill", "t0": 5.015, "w": w + 0.015,
+         "ms": 20.0, "pid": 2, "slot": 1},
+        {"trace": tid, "span": "decode", "t0": 5.035, "w": w + 0.035,
+         "ms": 40.0, "pid": 2, "slot": 1, "device_ms": 25.0},
+        {"trace": tid, "span": "stream", "t0": 5.02, "w": w + 0.02,
+         "ms": 60.0, "pid": 2, "tokens": 8},
+        {"trace": tid, "span": "finish", "t0": 5.075, "w": w + 0.075,
+         "ms": 0.0, "pid": 2, "reason": "length"},
+    ]
+
+
+def test_critical_path_attribution_exact():
+    cp = critical_path(_synthetic_chain())
+    assert cp["total_ms"] == 100.0
+    ph = cp["phases"]
+    assert ph["queue"] == 10.0
+    assert ph["prefill"] == 20.0
+    assert ph["device"] == 25.0
+    assert ph["decode"] == 15.0   # decode span minus its device share
+    assert ph["stream"] == 5.0    # stream end 80ms - decode end 75ms
+    assert ph["router"] == 25.0   # residual
+    # phases PARTITION the total by construction
+    assert abs(sum(ph.values()) - cp["total_ms"]) < 1e-6
+    assert critical_path([]) is None
+
+
+def test_critical_path_sums_failover_generations():
+    """A replayed request (two engine generations under one id) sums
+    per phase instead of dropping the first generation."""
+    chain = _synthetic_chain()
+    chain += [
+        {"trace": 42, "span": "queued", "t0": 6.0, "w": 1000.2,
+         "ms": 4.0, "pid": 3},
+        {"trace": 42, "span": "decode", "t0": 6.01, "w": 1000.21,
+         "ms": 10.0, "pid": 3, "device_ms": 6.0},
+    ]
+    ph = critical_path(chain)["phases"]
+    assert ph["queue"] == 14.0
+    assert ph["device"] == 31.0
+    assert ph["decode"] == 19.0
+
+
+def test_chrome_export_synthetic_chain():
+    doc = to_chrome_trace(_synthetic_chain())
+    assert doc["displayTimeUnit"] == "ms"
+    events = _assert_chrome_valid(doc, expect_flow=True)
+    # complete events: one per span, slot spans on their slot lane
+    xs = {e["name"]: e for e in events if e["ph"] == "X"}
+    assert set(xs) == {s["span"] for s in _synthetic_chain()}
+    assert xs["decode"]["tid"] == 2          # slot 1 -> lane 2
+    assert xs["decode"]["pid"] == 2
+    assert xs["router.route"]["tid"] == 98   # router lane
+    assert xs["stream"]["tid"] == 99         # stream lane
+    # flow chain: starts in the router process, finishes in the replica
+    flow = sorted((e for e in events if e["ph"] in ("s", "t", "f")),
+                  key=lambda e: e["ts"])
+    assert [e["ph"] for e in flow] == ["s", "f"]
+    assert flow[0]["pid"] == 1 and flow[1]["pid"] == 2
+    assert flow[0]["id"] == flow[1]["id"] == 42
+    # timestamps are microseconds relative to the chain start
+    assert xs["router.stream"]["ts"] == 0.0
+    assert abs(xs["decode"]["ts"] - 35e3) < 1.0
+    assert abs(xs["decode"]["dur"] - 40e3) < 1.0
+    assert to_chrome_trace([]) == {"traceEvents": [],
+                                   "displayTimeUnit": "ms"}
+
+
+def test_report_chrome_trace_cli(tmp_path, capsys):
+    """`report --chrome-trace out.json` writes a loadable export from
+    a span JSONL (optionally filtered to one trace)."""
+    path = tmp_path / "trace.jsonl"
+    with open(path, "w") as fh:
+        for s in _synthetic_chain(tid=42) + _synthetic_chain(tid=43):
+            fh.write(json.dumps(s) + "\n")
+    out = tmp_path / "chrome.json"
+    telemetry_report.main([str(path), "--chrome-trace", str(out)])
+    assert "ui.perfetto.dev" in capsys.readouterr().out
+    doc = json.loads(out.read_text())
+    _assert_chrome_valid(doc, expect_flow=True)
+    # --trace filters to one chain
+    telemetry_report.main([str(path), "--trace", "43",
+                           "--chrome-trace", str(out)])
+    doc = json.loads(out.read_text())
+    flows = {e["id"] for e in doc["traceEvents"] if e["ph"] == "s"}
+    assert flows == {43}
+    # unwritable output: the 1-line-error exit-2 contract
+    with pytest.raises(SystemExit) as exc:
+        telemetry_report.main([str(path), "--chrome-trace",
+                               str(tmp_path / "nope" / "x.json")])
+    assert exc.value.code == 2
+
+
+def test_report_trace_renders_critical_path_and_skew_note(tmp_path,
+                                                          capsys):
+    path = tmp_path / "trace.jsonl"
+    with open(path, "w") as fh:
+        for s in _synthetic_chain():
+            fh.write(json.dumps(s) + "\n")
+    telemetry_report.main([str(path), "--trace", "42"])
+    out = capsys.readouterr().out
+    assert "critical path" in out
+    for phase in ("queue", "prefill", "decode", "device", "stream",
+                  "router"):
+        assert phase in out
+    # the multi-process merge is flagged with the skew caveat
+    assert "NTP" in out
+    # internal anchor stamps are rendering inputs, not display attrs
+    assert "w=" not in out
+
+
+def test_http_chrome_endpoint():
+    """The scrape server's /chrome route serves the tracer's spans as
+    a loadable Chrome-trace doc (?trace= filters one chain)."""
+    from urllib.request import urlopen
+
+    tr = Tracer(pid=9)
+    tid = tr.new_trace_id()
+    tr.record(tid, "queued", time.monotonic(), 1.0)
+    tr.record(tr.new_trace_id(), "queued", time.monotonic(), 1.0)
+    srv = telemetry.TelemetryServer(tracer=tr).start()
+    try:
+        doc = json.loads(urlopen(
+            f"http://127.0.0.1:{srv.port}/chrome?trace={tid}",
+            timeout=10).read())
+        events = _assert_chrome_valid(doc)
+        assert [e for e in events if e["ph"] == "X"][0]["pid"] == 9
+        assert len([e for e in events if e["ph"] == "X"]) == 1
+    finally:
+        srv.stop()
+
+
+def test_import_hygiene_covers_new_telemetry_modules(tmp_path):
+    """The stdlib-only boundary explicitly covers the tracing layer:
+    trace.py and the new chrome.py are inside the declared surface,
+    pass clean as written, and a third-party import injected into a
+    copy of chrome.py is flagged."""
+    from distkeras_tpu.analysis.core import SourceFile
+    from distkeras_tpu.analysis.imports import ImportHygienePass
+    import distkeras_tpu.telemetry.chrome as chrome_mod
+    import distkeras_tpu.telemetry.trace as trace_mod
+
+    p = ImportHygienePass()
+    for mod in (chrome_mod, trace_mod):
+        rel = "distkeras_tpu/telemetry/" + os.path.basename(mod.__file__)
+        assert p._is_stdlib_only_file(rel)
+        with open(mod.__file__) as fh:
+            src = SourceFile(mod.__file__, rel, fh.read())
+        assert list(p.run(src)) == []
+    bad = ("import numpy as np\n"
+           + open(chrome_mod.__file__).read())
+    src = SourceFile(str(tmp_path / "chrome.py"),
+                     "distkeras_tpu/telemetry/chrome.py", bad)
+    findings = list(p.run(src))
+    assert any(f.key == "third-party.numpy" for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# end to end: propagation through server and router fleet
+# ---------------------------------------------------------------------------
+
+def test_trace_propagation_direct_server(model_and_params):
+    """A client-propagated trace id survives the wire: the ack echoes
+    it, the replica's whole span chain records under it (queued linked
+    to the named parent span), and the engine's stats surface the
+    critical-path phases."""
+    model, params = model_and_params
+    server = _server(model, params, pid=500)
+    client = ServingClient("127.0.0.1", server.port)
+    try:
+        my_tid = 123456789012345
+        rid = client.generate(np.arange(1, 7, dtype=np.int32),
+                              max_new_tokens=6, trace=my_tid,
+                              parent_span="client.call")
+        toks, reason = client.result(rid, timeout=60)
+        assert len(toks) == 6 and reason == "length"
+        assert client.trace_of(rid) == my_tid
+        chain = {s["span"]: s for s in client.trace_dump(trace=my_tid)}
+        assert set(chain) == {"queued", "prefill", "decode", "finish",
+                              "stream"}
+        assert chain["queued"]["parent"] == "client.call"
+        assert chain["decode"]["device_ms"] >= 0.0
+        assert all(s["pid"] == 500 for s in chain.values())
+        cp = server.engine.stats()["critical_path_ms"]
+        assert set(cp) == {"queue", "prefill", "decode", "device"}
+        assert cp["queue"]["p50"] is not None
+        # without a propagated id the server mints its own (and it is
+        # not a small per-process counter value)
+        rid2 = client.generate(np.arange(1, 7, dtype=np.int32),
+                               max_new_tokens=2)
+        client.result(rid2, timeout=60)
+        assert client.trace_of(rid2) not in (None, my_tid)
+    finally:
+        client.close()
+        server.stop()
+
+
+def test_router_one_trace_across_fleet(model_and_params):
+    """The acceptance-criteria path: ONE trace id spans client submit →
+    router.route → replica queued/prefill/decode/stream → finish across
+    ≥2 tracer processes; the router's trace_dump answers the merged
+    chain; its critical-path phase sums land within 5% of the
+    client-observed latency; the chrome_trace op exports a valid doc;
+    and the archive keeps answering after every live ring is cleared."""
+    model, params = model_and_params
+    servers, router = _fleet(model, params, n=2)
+    client = ServingClient("127.0.0.1", router.port)
+    try:
+        rng = np.random.default_rng(0)
+        # warm: compile both replicas' tick shapes so the measured
+        # request's latency is serving time, not jit time
+        for _ in range(2):
+            for sz in (6, 7):
+                r = client.generate(
+                    rng.integers(0, 64, size=sz).astype(np.int32),
+                    max_new_tokens=2)
+                client.result(r, timeout=120)
+        prompt = rng.integers(0, 64, size=6).astype(np.int32)
+        t0 = time.monotonic()
+        rid = client.generate(prompt, max_new_tokens=24)
+        toks, reason = client.result(rid, timeout=120)
+        client_ms = (time.monotonic() - t0) * 1e3
+        assert len(toks) == 24 and reason == "length"
+        tid = client.trace_of(rid)
+        assert tid is not None
+        chain = client.trace_dump(trace=tid)
+        assert {s["trace"] for s in chain} == {tid}
+        names = {s["span"] for s in chain}
+        assert FLEET_CHAIN <= names, names
+        assert len({s["pid"] for s in chain}) >= 2
+        cp = critical_path(chain)
+        assert set(cp["phases"]) == set(telemetry.CRITICAL_PATH_PHASES)
+        total = sum(cp["phases"].values())
+        # phase sums vs what the client measured around submit->done:
+        # 5% of the stream latency, floored at 15 ms for the wire/ack
+        # overhead a sub-100ms CPU smoke cannot amortize
+        assert abs(total - client_ms) <= max(0.05 * client_ms, 15.0), (
+            total, client_ms, cp)
+        doc = client.chrome_trace(trace=tid)
+        events = _assert_chrome_valid(doc, expect_flow=True)
+        assert {e["id"] for e in events if e["ph"] == "s"} == {tid}
+        # archived chain outlives every live ring
+        st = client.stats()["router"]
+        assert st["trace_archive"]["archived"] >= 1
+        assert st["trace_archive"]["errors"] == 0
+        router.tracer.clear()
+        for s in servers:
+            s.engine.tracer.clear()
+        chain2 = client.trace_dump(trace=tid)
+        assert FLEET_CHAIN <= {s["span"] for s in chain2}
+        # router-side phase histogram saw the request
+        assert st["critical_path_ms"]["router"]["p50"] is not None
+    finally:
+        _stop(servers, router, [client])
+
+
+@pytest.mark.slow  # ~15 s of streaming + kill + replay: multichip CI job
+def test_failover_replay_keeps_trace_id(model_and_params):
+    """Kill the replica serving a stream mid-flight: the replayed
+    stream completes under the ORIGINAL trace id, the merged chain
+    gains the router.failover link span plus the survivor's second
+    engine generation, and zero ids were re-minted."""
+    model, params = model_and_params
+    servers, router = _fleet(model, params, n=2)
+    client = ServingClient("127.0.0.1", router.port)
+    try:
+        rng = np.random.default_rng(3)
+        prompts = [rng.integers(0, 64, size=6).astype(np.int32)
+                   for _ in range(4)]
+        rids = [client.generate(p, max_new_tokens=40) for p in prompts]
+        tids = {rid: client.trace_of(rid) for rid in rids}
+        deadline = time.monotonic() + 10
+        by = {}
+        while time.monotonic() < deadline:
+            by = router.stats()["router"]["inflight_by_replica"]
+            if by and max(by.values()) >= 2:
+                break
+            time.sleep(0.01)
+        victim = max(by, key=by.get)
+        servers[int(victim[1:])].stop()
+        for rid in rids:
+            toks, reason = client.result(rid, timeout=120)
+            assert len(toks) == 40 and reason == "length"
+        st = client.stats()["router"]
+        assert st["failed"] == 0 and st["failed_over"] >= 1
+        failed_over = [
+            s for tid in tids.values()
+            for s in client.trace_dump(trace=tid)
+            if s["span"] == "router.failover"
+        ]
+        assert failed_over, "no failover link span on any trace"
+        # the replayed request's whole chain — original id throughout,
+        # replay marked on the router.stream span
+        replayed_tid = failed_over[0]["trace"]
+        assert replayed_tid in tids.values()
+        chain = client.trace_dump(trace=replayed_tid)
+        assert {s["trace"] for s in chain} == {replayed_tid}
+        names = [s["span"] for s in chain]
+        assert "router.failover" in names
+        # the survivor re-ran the request under the SAME id: its full
+        # engine generation is in the merged chain (the dead replica's
+        # spans died with its process — the failover link span and the
+        # replay count on router.stream are the durable record)
+        assert {"queued", "prefill", "decode", "finish",
+                "router.stream"} <= set(names)
+        rstream = [s for s in chain if s["span"] == "router.stream"]
+        assert rstream and rstream[0]["replays"] >= 1
+    finally:
+        _stop(servers, router, [client])
+
+
+def test_router_trace_concurrent_clients_distinct_ids(model_and_params):
+    """Concurrent submits through one router: every request gets its
+    own fleet-unique id, every merged chain is complete, and no span
+    leaks across chains (the dedupe-keyed merge path under real
+    concurrency)."""
+    model, params = model_and_params
+    servers, router = _fleet(model, params, n=2)
+    client = ServingClient("127.0.0.1", router.port, request_timeout=120)
+    try:
+        rng = np.random.default_rng(5)
+        prompts = [rng.integers(0, 64, size=6).astype(np.int32)
+                   for _ in range(8)]
+        results = {}
+        lock = threading.Lock()
+
+        def worker(i):
+            rid = client.generate(prompts[i], max_new_tokens=6)
+            toks, reason = client.result(rid, timeout=120)
+            with lock:
+                results[i] = (client.trace_of(rid), toks, reason)
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(len(prompts))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert len(results) == len(prompts)
+        tids = [tid for tid, _, _ in results.values()]
+        assert len(set(tids)) == len(tids)
+        for tid, toks, reason in results.values():
+            assert reason == "length" and len(toks) == 6
+            chain = client.trace_dump(trace=tid)
+            assert {s["trace"] for s in chain} == {tid}
+            assert FLEET_CHAIN <= {s["span"] for s in chain}
+    finally:
+        _stop(servers, router, [client])
