@@ -1,6 +1,6 @@
 """Repo-native static analysis: the stack's invariants as code.
 
-``python -m distkeras_tpu.analysis`` runs five AST passes (stdlib
+``python -m distkeras_tpu.analysis`` runs nine AST passes (stdlib
 ``ast`` only — no third-party parser) over the package and checks the
 result against the checked-in baseline (``analysis-baseline.txt``):
 
@@ -15,6 +15,28 @@ result against the checked-in baseline (``analysis-baseline.txt``):
   value-stable (:mod:`~distkeras_tpu.analysis.recompile`);
 - ``import-hygiene`` — stdlib-only layers stay stdlib-only; package
   code never imports tests (:mod:`~distkeras_tpu.analysis.imports`).
+
+Four cross-boundary contract passes join them (PR 12) — the contracts
+that span processes and modules, enforced only by convention before:
+
+- ``wire-contract`` — the framed-msgpack op protocol, re-derived from
+  ``LMServer._handle`` / ``Router._handle`` / ``ServingClient`` call
+  sites and cross-checked (unhandled/unreachable/unproxied ops,
+  unsent request fields, unset reply keys, docstring drift); the same
+  extraction generates ``docs/PROTOCOL.md`` via the ``protocol``
+  subcommand (:mod:`~distkeras_tpu.analysis.wire`);
+- ``metric-contract`` — metric families as one namespace: label-set
+  consistency, read-side references to undeclared families, declared-
+  but-never-written families
+  (:mod:`~distkeras_tpu.analysis.metrics_contract`);
+- ``span-contract`` — span names with real durations must be known to
+  the ``critical_path()`` partition, and critical-path ``phase``
+  label values must come from ``CRITICAL_PATH_PHASES``
+  (:mod:`~distkeras_tpu.analysis.spans`);
+- ``host-sync-hazard`` — no blocking device sync (``np.asarray``,
+  ``.item()``, ``block_until_ready``, ``device_get``, tainted
+  ``int()``/``float()``) inside ``_plan_dispatch_*`` bodies or their
+  same-file callees (:mod:`~distkeras_tpu.analysis.hostsync`).
 
 A finding is silenced either by a line-level suppression comment
 (``# analysis: <slug>``, e.g. ``# analysis: unguarded-ok``) for
@@ -37,6 +59,7 @@ from distkeras_tpu.analysis.core import (  # noqa: F401
     Baseline,
     Finding,
     Pass,
+    ProjectPass,
     SourceFile,
     analyze,
     split_by_baseline,
@@ -46,10 +69,14 @@ from distkeras_tpu.analysis.core import (  # noqa: F401
 def default_passes():
     """Fresh instances of every pass, in report order."""
     from distkeras_tpu.analysis.donation import DonationSafetyPass
+    from distkeras_tpu.analysis.hostsync import HostSyncHazardPass
     from distkeras_tpu.analysis.imports import ImportHygienePass
     from distkeras_tpu.analysis.locks import LockDisciplinePass
+    from distkeras_tpu.analysis.metrics_contract import MetricContractPass
     from distkeras_tpu.analysis.recompile import RecompileHazardPass
     from distkeras_tpu.analysis.rng import RngDisciplinePass
+    from distkeras_tpu.analysis.spans import SpanContractPass
+    from distkeras_tpu.analysis.wire import WireContractPass
 
     return [
         LockDisciplinePass(),
@@ -57,6 +84,10 @@ def default_passes():
         RngDisciplinePass(),
         RecompileHazardPass(),
         ImportHygienePass(),
+        WireContractPass(),
+        MetricContractPass(),
+        SpanContractPass(),
+        HostSyncHazardPass(),
     ]
 
 
@@ -65,6 +96,7 @@ __all__ = [
     "Baseline",
     "Finding",
     "Pass",
+    "ProjectPass",
     "SourceFile",
     "analyze",
     "split_by_baseline",

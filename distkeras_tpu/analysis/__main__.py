@@ -7,9 +7,15 @@ Check mode (the CI lint job)::
 
 Report mode (findings as data; same exit-code contract as
 ``telemetry.report`` — bad input exits 2 with a one-line error, no
-traceback)::
+traceback; ``--rule`` inspects one pass's findings in isolation)::
 
-    python -m distkeras_tpu.analysis report [--json] [paths...]
+    python -m distkeras_tpu.analysis report [--json] [--rule R] [paths...]
+
+Protocol mode (the wire-contract extraction rendered as the generated
+op reference; ``--check`` fails on drift — the CI guard keeping
+``docs/PROTOCOL.md`` authoritative)::
+
+    python -m distkeras_tpu.analysis protocol [--out PATH] [--check PATH]
 
 Defaults: scan the installed ``distkeras_tpu`` package; baseline at
 ``analysis-baseline.txt`` next to the package (the repo root in a
@@ -17,8 +23,10 @@ checkout), falling back to the current directory.
 
 Exit codes, check mode: 0 = clean or everything baselined; 1 =
 unbaselined findings under ``--strict`` (without it they are printed
-as warnings); 2 = unusable input. Report mode never fails on
-findings — it only reports them.
+as warnings) — or, also under ``--strict``, baseline entries whose
+justification is empty or still ``TODO`` (the ledger must not rot);
+2 = unusable input. Report mode never fails on findings — it only
+reports them. Protocol mode exits 1 on ``--check`` drift, else 0.
 """
 
 from __future__ import annotations
@@ -97,12 +105,23 @@ def check_main(args) -> int:
               "(suppress with '# analysis: <slug>' where justified, "
               "or baseline with --write-baseline + a justification)")
         return 1
+    if args.strict and baseline is not None:
+        unjust = baseline.unjustified()
+        if unjust:
+            for fp in unjust:
+                print("unjustified baseline entry (replace the TODO "
+                      "with a real justification): " + "\t".join(fp))
+            print(f"strict mode: {len(unjust)} baseline entr(y/ies) "
+                  f"without justification fail the build")
+            return 1
     return 0
 
 
 def report_main(args) -> int:
     roots, _bl_path, baseline = _resolve(args)
     findings = analyze(roots)
+    if args.rule:
+        findings = [f for f in findings if f.rule == args.rule]
     new, accepted = split_by_baseline(findings, baseline)
     if args.json:
         payload = {
@@ -132,40 +151,97 @@ def report_main(args) -> int:
     return 0
 
 
-def _parser(report: bool) -> argparse.ArgumentParser:
+def protocol_main(args) -> int:
+    from distkeras_tpu.analysis.core import iter_source_files
+    from distkeras_tpu.analysis.wire import (
+        extract_protocol,
+        render_protocol_md,
+    )
+
+    roots = args.paths or [default_root()]
+    proto = extract_protocol(iter_source_files(roots))
+    if proto.server is None and proto.client is None:
+        raise AnalysisError(
+            "no LMServer/ServingClient found under "
+            + ", ".join(roots)
+        )
+    text = render_protocol_md(proto)
+    if args.check:
+        try:
+            with open(args.check, encoding="utf-8") as fh:
+                on_disk = fh.read()
+        except OSError as e:
+            raise AnalysisError(
+                f"cannot read {args.check}: {e.strerror or e}"
+            ) from None
+        if on_disk != text:
+            print(f"protocol drift: {args.check} does not match the "
+                  f"extraction — regenerate with\n  python -m "
+                  f"distkeras_tpu.analysis protocol --out {args.check}")
+            return 1
+        print(f"{args.check} is up to date")
+        return 0
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as fh:
+            fh.write(text)
+        print(f"wrote {args.out}")
+    else:
+        sys.stdout.write(text)
+    return 0
+
+
+def _parser(mode: str) -> argparse.ArgumentParser:
     ap = argparse.ArgumentParser(
         prog="python -m distkeras_tpu.analysis"
-             + (" report" if report else ""),
+             + (f" {mode}" if mode != "check" else ""),
         description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter,
     )
     ap.add_argument("paths", nargs="*",
                     help="files or package dirs to scan (default: the "
                          "installed distkeras_tpu package)")
-    ap.add_argument("--baseline", default=None,
-                    help=f"baseline file (default: {BASELINE_NAME} next "
-                         f"to the package, else ./{BASELINE_NAME})")
-    ap.add_argument("--no-baseline", action="store_true",
-                    help="ignore any baseline file")
-    if report:
+    if mode != "protocol":
+        ap.add_argument("--baseline", default=None,
+                        help=f"baseline file (default: {BASELINE_NAME} "
+                             f"next to the package, else "
+                             f"./{BASELINE_NAME})")
+        ap.add_argument("--no-baseline", action="store_true",
+                        help="ignore any baseline file")
+    if mode == "report":
         ap.add_argument("--json", action="store_true",
                         help="emit findings as JSON instead of a table")
+        ap.add_argument("--rule", default=None,
+                        help="only findings of this rule (inspect one "
+                             "pass in isolation, e.g. wire-contract)")
+    elif mode == "protocol":
+        ap.add_argument("--out", default=None,
+                        help="write the generated op reference here "
+                             "(default: stdout)")
+        ap.add_argument("--check", default=None, metavar="PATH",
+                        help="compare against PATH and exit 1 on "
+                             "drift (the CI guard for docs/PROTOCOL.md)")
     else:
         ap.add_argument("--strict", action="store_true",
-                        help="exit 1 on unbaselined findings (CI mode)")
+                        help="exit 1 on unbaselined findings or "
+                             "unjustified baseline entries (CI mode)")
         ap.add_argument("--write-baseline", action="store_true",
                         help="regenerate the baseline from current "
                              "findings (keeps existing justifications)")
     return ap
 
 
+_MODES = {"report": report_main, "protocol": protocol_main,
+          "check": check_main}
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
-    report = bool(argv) and argv[0] == "report"
-    if report:
+    mode = "check"
+    if argv and argv[0] in _MODES:
+        mode = argv[0]
         argv = argv[1:]
-    args = _parser(report).parse_args(argv)
+    args = _parser(mode).parse_args(argv)
     try:
-        return report_main(args) if report else check_main(args)
+        return _MODES[mode](args)
     except AnalysisError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2

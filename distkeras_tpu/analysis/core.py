@@ -115,6 +115,21 @@ class Pass:
         raise NotImplementedError
 
 
+class ProjectPass(Pass):
+    """A pass whose contract spans files: wire protocol arms live in
+    ``server.py`` *and* ``router.py``, a metric family is declared in
+    one module and observed in another. Instead of per-file ``run``,
+    a project pass sees the whole scanned file set at once and emits
+    findings against any of them (suppression comments still apply at
+    each finding's own line)."""
+
+    def run(self, src: SourceFile) -> Iterable[Finding]:
+        return ()  # project passes only run in run_project
+
+    def run_project(self, srcs: Sequence[SourceFile]) -> Iterable[Finding]:
+        raise NotImplementedError
+
+
 @dataclass
 class Baseline:
     """The checked-in ledger of accepted findings with justifications."""
@@ -156,6 +171,16 @@ class Baseline:
         removal (the code they excused has been fixed or moved)."""
         live = {f.fingerprint() for f in findings}
         return sorted(fp for fp in self.entries if fp not in live)
+
+    def unjustified(self) -> List[Tuple[str, str, str]]:
+        """Entries whose justification is empty or still the
+        ``TODO: justify`` marker ``--write-baseline`` stamps on new
+        keys. ``--strict`` fails on these: an accepted finding nobody
+        has explained is a rotting ledger entry, not an acceptance."""
+        return sorted(
+            fp for fp, just in self.entries.items()
+            if not just.strip() or just.strip().upper().startswith("TODO")
+        )
 
     def write(self, path: str, findings: Sequence[Finding]) -> int:
         """Regenerate the baseline from ``findings``: persisting keys
@@ -219,10 +244,18 @@ def analyze(roots: Sequence[str],
 
         passes = default_passes()
     findings: List[Finding] = []
-    for src in iter_source_files(roots):
+    srcs = iter_source_files(roots)
+    by_rel = {src.rel: src for src in srcs}
+    for src in srcs:
         for p in passes:
             for f in p.run(src):
                 if not src.suppressed(f.line, p.suppression):
+                    findings.append(f)
+    for p in passes:
+        if isinstance(p, ProjectPass):
+            for f in p.run_project(srcs):
+                src = by_rel.get(f.path)
+                if src is None or not src.suppressed(f.line, p.suppression):
                     findings.append(f)
     findings.sort(key=lambda f: (f.path, f.line, f.rule, f.key))
     return findings

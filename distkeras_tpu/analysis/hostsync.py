@@ -1,0 +1,209 @@
+"""Host-sync-hazard pass: plan/dispatch bodies must never block on the
+device.
+
+PR 10's pipelined loop rests on one documented rule: everything before
+the dispatch (``_plan_dispatch_mixed`` / ``_plan_dispatch_spec`` /
+``_plan_dispatch_decode``) plans from *host* state only, and the
+deferred readback happens exclusively at ``_reconcile`` time. One
+``np.asarray(device_value)`` hoisted into a plan body silently
+serializes the pipeline — the host blocks on tick N inside the very
+function whose whole point is to run while tick N is still on the
+device. The overlap quietly disappears; nothing fails.
+
+This pass walks every ``_plan_dispatch*`` function and everything it
+calls *in the same file* (``self.<method>(...)`` and module-level
+helpers, transitively) and flags the blocking-readback shapes:
+
+- ``np.asarray(...)`` / ``np.array(...)`` — device→host
+  materialization (``jnp.asarray`` is the host→device upload and is
+  allowed; so is ``np.ascontiguousarray`` on host control arrays);
+- ``.item()`` — the classic one-element sync;
+- ``.block_until_ready()`` — an explicit barrier;
+- ``jax.device_get(...)``;
+- ``int(...)`` / ``float(...)`` of a *device-tainted* value — a name
+  (or element of one) bound from calling a jitted tick function, i.e.
+  a local produced by a ``*_fn(...)``-built callable. Host-side
+  ``int(...)`` casts (lengths, host numpy lookups like the n-gram
+  drafter's) are untouched.
+
+Findings are keyed ``<plan root>:<site function>.<shape>`` so a
+hazard inside a shared helper names the plan path that reaches it.
+The documented exceptions (speculative planning legitimately needs
+host values that depend on the previous verify) use the standard
+``# analysis: host-sync-ok`` suppression at the site.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from distkeras_tpu.analysis.core import Finding, Pass, SourceFile
+
+PLAN_PREFIX = "_plan_dispatch"
+
+_NP_NAMES = {"np", "numpy"}
+_READBACK_ATTRS = {"asarray", "array"}
+
+
+def _walk_shallow(fn: ast.FunctionDef) -> Iterator[ast.AST]:
+    """Walk a function body without descending into nested function
+    defs: a jitted inner body (the tick builders return those) runs at
+    trace time / on device, not on the plan path's host thread."""
+    stack: List[ast.AST] = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _callee_name(node: ast.Call) -> Optional[Tuple[str, bool]]:
+    """(name, is_self_method) for calls resolvable within one file."""
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id, False
+    if (isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name)
+            and f.value.id == "self"):
+        return f.attr, True
+    return None
+
+
+def _collect_defs(tree: ast.Module) -> Dict[str, ast.FunctionDef]:
+    """Every function def in the file by name: module-level functions
+    and methods alike (names are unique enough within one module for
+    the call-graph walk; a collision only widens the scope checked)."""
+    defs: Dict[str, ast.FunctionDef] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, node)
+    return defs
+
+
+def _tainted_names(fn: ast.FunctionDef) -> Set[str]:
+    """Locals carrying device values: targets (incl. tuple-unpacked)
+    of assignments whose RHS calls a tick builder's product — a name
+    bound from a ``*_fn(...)`` call, or a direct ``*_fn(...)(...)``
+    chain."""
+    builders: Set[str] = set()
+    tainted: Set[str] = set()
+    for node in _walk_shallow(fn):
+        if not isinstance(node, ast.Assign):
+            continue
+        v = node.value
+        if isinstance(v, ast.Call):
+            cal = _callee_name(v)
+            if cal is not None and cal[0].endswith("_fn"):
+                # tick = _mixed_tick_fn(...): the callable itself
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        builders.add(tgt.id)
+                continue
+    for node in _walk_shallow(fn):
+        if not isinstance(node, ast.Assign):
+            continue
+        v = node.value
+        if not isinstance(v, ast.Call):
+            continue
+        cal = _callee_name(v)
+        if cal is None or cal[0] not in builders:
+            continue
+        for tgt in node.targets:
+            for leaf in ast.walk(tgt):
+                if isinstance(leaf, ast.Name):
+                    tainted.add(leaf.id)
+    return tainted
+
+
+def _mentions(node, names: Set[str]) -> bool:
+    for leaf in ast.walk(node):
+        if isinstance(leaf, ast.Name) and leaf.id in names:
+            return True
+    return False
+
+
+class HostSyncHazardPass(Pass):
+    rule = "host-sync-hazard"
+    suppression = "host-sync-ok"
+
+    def run(self, src: SourceFile) -> Iterator[Finding]:
+        defs = _collect_defs(src.tree)
+        roots = sorted(n for n in defs if n.startswith(PLAN_PREFIX))
+        if not roots:
+            return
+        for root in roots:
+            # reachable same-file functions, breadth-first
+            order: List[str] = [root]
+            seen: Set[str] = {root}
+            i = 0
+            while i < len(order):
+                fn = defs[order[i]]
+                i += 1
+                for node in _walk_shallow(fn):
+                    if isinstance(node, ast.Call):
+                        cal = _callee_name(node)
+                        if (cal is not None and cal[0] in defs
+                                and cal[0] not in seen):
+                            seen.add(cal[0])
+                            order.append(cal[0])
+            for name in order:
+                yield from self._scan_fn(src, root, defs[name])
+
+    def _scan_fn(self, src: SourceFile, root: str,
+                 fn: ast.FunctionDef) -> Iterator[Finding]:
+        where = (fn.name if fn.name == root
+                 else f"{fn.name} (reached from {root})")
+        tainted = _tainted_names(fn)
+        for node in _walk_shallow(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if isinstance(f, ast.Attribute):
+                if (f.attr in _READBACK_ATTRS
+                        and isinstance(f.value, ast.Name)
+                        and f.value.id in _NP_NAMES):
+                    yield self._finding(
+                        src, node, root, fn,
+                        f"np.{f.attr}",
+                        f"{where} materializes a value on host via "
+                        f"np.{f.attr}: a blocking device sync in plan "
+                        f"scope (defer the readback to _reconcile)",
+                    )
+                elif f.attr == "item" and not node.args:
+                    yield self._finding(
+                        src, node, root, fn, "item",
+                        f"{where} calls .item(): a one-element "
+                        f"blocking device sync in plan scope",
+                    )
+                elif f.attr == "block_until_ready":
+                    yield self._finding(
+                        src, node, root, fn, "block_until_ready",
+                        f"{where} calls .block_until_ready(): an "
+                        f"explicit device barrier in plan scope",
+                    )
+                elif (f.attr == "device_get"
+                      and isinstance(f.value, ast.Name)
+                      and f.value.id == "jax"):
+                    yield self._finding(
+                        src, node, root, fn, "device_get",
+                        f"{where} calls jax.device_get: a blocking "
+                        f"device transfer in plan scope",
+                    )
+            elif (isinstance(f, ast.Name) and f.id in ("int", "float")
+                  and len(node.args) == 1
+                  and tainted and _mentions(node.args[0], tainted)):
+                yield self._finding(
+                    src, node, root, fn, f.id,
+                    f"{where} casts a device-tainted value with "
+                    f"{f.id}(): a one-element blocking sync in plan "
+                    f"scope",
+                )
+
+    def _finding(self, src: SourceFile, node, root: str,
+                 fn: ast.FunctionDef, shape: str, msg: str) -> Finding:
+        return Finding(
+            rule=self.rule, path=src.rel, line=node.lineno,
+            key=f"{root}:{fn.name}.{shape}", message=msg,
+        )

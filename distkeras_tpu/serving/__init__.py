@@ -44,6 +44,7 @@ from distkeras_tpu.serving.server import (  # noqa: F401
     OverloadedError,
     ServingClient,
     ServingConnectionError,
+    UnknownOpError,
 )
 from distkeras_tpu.serving.fleet import (  # noqa: F401
     Replica,
@@ -64,6 +65,7 @@ __all__ = [
     "DrainingError",
     "OverloadedError",
     "ServingConnectionError",
+    "UnknownOpError",
     "DISCONNECTED",
     "Request",
     "TokenStream",

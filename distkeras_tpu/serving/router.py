@@ -764,8 +764,12 @@ class Router:
                                      " — scrape replicas directly",
                         })
                     else:
+                        # typed terminal arm, mirroring LMServer: the
+                        # proxied op set is closed and the wire-contract
+                        # pass can hold it equal to the server's
                         self._send(conn, lock, {
-                            "ok": 0, "error": f"unknown op {op!r}",
+                            "ok": 0, "error": "unknown_op",
+                            "op": str(op),
                         })
                 except OverloadedError as e:
                     self._send(conn, lock, {

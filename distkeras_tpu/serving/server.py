@@ -32,6 +32,12 @@ Protocol (all frames are msgpack dicts):
                                               # spill, callers back off)
     {"ok": 0, "error": "draining"}            # admissions closed (typed:
                                               # DrainingError)
+    {"ok": 0, "error": "unknown_op", "op": op}
+                                              # unrecognized op (typed:
+                                              # UnknownOpError — the
+                                              # terminal dispatch arm, so
+                                              # the handled op set is
+                                              # closed and checkable)
     {"id": rid, "t": tok}                     # one streamed token
     {"id": rid, "done": 1, "reason": r, "n": k}   # stream end
     {"ok": 1, "stats": {...}}                 # stats reply
@@ -109,6 +115,19 @@ class OverloadedError(RuntimeError):
     def __init__(self, msg: str, queue_depth=None):
         super().__init__(msg)
         self.queue_depth = queue_depth
+
+
+class UnknownOpError(RuntimeError):
+    """The server (or router) did not recognize the requested op — the
+    typed reply of the terminal dispatch arm. Distinct from a hard
+    failure: the connection is healthy, the protocol surface simply
+    does not include the op (a version-skewed client, a typo'd op
+    name). ``op`` carries the rejected op name as the server echoed
+    it."""
+
+    def __init__(self, msg: str, op=None):
+        super().__init__(msg)
+        self.op = op
 
 
 class ServingConnectionError(ConnectionError, RuntimeError):
@@ -361,8 +380,13 @@ class LMServer:
                             "queued": st["queue_depth"],
                         })
                     else:
-                        self._send(conn, lock,
-                                   {"ok": 0, "error": f"unknown op {op!r}"})
+                        # typed terminal arm: the handled op set above
+                        # is CLOSED — the wire-contract pass extracts
+                        # it as exact, and clients raise UnknownOpError
+                        self._send(conn, lock, {
+                            "ok": 0, "error": "unknown_op",
+                            "op": str(op),
+                        })
                 except (ConnectionError, OSError):
                     raise
                 except QueueFullError:
@@ -532,6 +556,13 @@ class ServingClient:
                     f"server at {self.host}:{self.port} is draining "
                     f"(admissions closed)"
                 )
+            if err == "unknown_op":
+                bad = reply.get("op")
+                raise UnknownOpError(
+                    f"server at {self.host}:{self.port} does not "
+                    f"handle op {bad!r}",
+                    op=bad,
+                )
             raise RuntimeError(err)
         return reply
 
@@ -656,14 +687,22 @@ class ServingClient:
         server has no monitor attached."""
         return list(self._call({"op": "alerts"})["alerts"])
 
-    def drain(self) -> dict:
+    def drain(self, replica: Optional[str] = None) -> dict:
         """Gracefully drain the server: admissions close immediately
         (subsequent :meth:`generate` calls raise
         :class:`~distkeras_tpu.serving.DrainingError`), queued and
         in-flight streams finish. Returns ``{"active": slots_busy,
         "queued": depth}`` at drain time; poll :meth:`stats` for
-        ``drained`` before stopping the process."""
-        reply = self._call({"op": "drain"})
+        ``drained`` before stopping the process.
+
+        ``replica`` is meaningful against a :class:`Router`: the named
+        backend replica is drained and taken out of routing (the
+        rolling-deploy primitive) while the router keeps admitting. A
+        direct LMServer ignores the field and drains itself."""
+        msg: dict = {"op": "drain"}
+        if replica is not None:
+            msg["replica"] = str(replica)
+        reply = self._call(msg)
         return {"active": int(reply.get("active", 0)),
                 "queued": int(reply.get("queued", 0))}
 

@@ -438,10 +438,27 @@ def test_baseline_rejects_malformed(tmp_path):
 
 
 def test_cli_strict_exit_codes(tmp_path, capsys):
+    """The add -> justify -> pass round trip: --write-baseline stamps
+    new entries "TODO: justify", and --strict refuses to accept them
+    until a human replaces the marker — the ledger cannot rot."""
     src = _write(tmp_path, "m.py", LOCK_BAD)
     assert analysis_main([src, "--no-baseline"]) == 0  # warn only
     assert analysis_main([src, "--no-baseline", "--strict"]) == 1
     bl = str(tmp_path / "bl.txt")
+    assert analysis_main([src, "--baseline", bl,
+                          "--write-baseline"]) == 0
+    # baselined, but unjustified: strict still fails, naming the entry
+    assert analysis_main([src, "--baseline", bl, "--strict"]) == 1
+    out = capsys.readouterr().out
+    assert "unjustified baseline entry" in out
+    # justify it: strict passes
+    text = open(bl).read()
+    assert "TODO: justify" in text
+    with open(bl, "w") as fh:
+        fh.write(text.replace("TODO: justify",
+                              "monitor read, racy by design"))
+    assert analysis_main([src, "--baseline", bl, "--strict"]) == 0
+    # regeneration preserves the justification, so strict keeps passing
     assert analysis_main([src, "--baseline", bl,
                           "--write-baseline"]) == 0
     assert analysis_main([src, "--baseline", bl, "--strict"]) == 0
@@ -496,8 +513,31 @@ def test_analyzer_clean_on_installed_package():
 
 def test_every_pass_has_distinct_rule_and_suppression():
     passes = default_passes()
-    assert len({p.rule for p in passes}) == len(passes) == 5
+    assert len({p.rule for p in passes}) == len(passes) == 9
     assert len({p.suppression for p in passes}) == len(passes)
+
+
+def test_report_rule_filter(tmp_path, capsys):
+    """``report --rule`` inspects one pass's findings in isolation."""
+    src = _write(tmp_path, "m.py", LOCK_BAD + """
+
+        import jax
+
+        def reuse(rng):
+            a = jax.random.uniform(rng)
+            b = jax.random.normal(rng)
+            return a, b
+    """)
+    assert analysis_main(["report", src, "--no-baseline",
+                          "--json"]) == 0
+    rules = {f["rule"] for f in
+             json.loads(capsys.readouterr().out)["findings"]}
+    assert {"lock-discipline", "rng-discipline"} <= rules
+    assert analysis_main(["report", src, "--no-baseline", "--json",
+                          "--rule", "rng-discipline"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["findings"]
+    assert {f["rule"] for f in payload["findings"]} == {"rng-discipline"}
 
 
 # -- dynamic lock-order detector ---------------------------------------------

@@ -127,8 +127,13 @@ def test_router_wire_compat_and_parity(model_and_params):
         spans = {s["span"]
                  for s in client.trace_dump(trace=client.trace_of(rids[0]))}
         assert {"router.route", "router.stream"} <= spans
-        with pytest.raises(RuntimeError, match="unknown op"):
+        # typed unknown-op rejection across the router hop: same
+        # {"error": "unknown_op", "op": ...} terminal arm as a direct
+        # LMServer, surfaced as the same typed client error
+        from distkeras_tpu.serving import UnknownOpError
+        with pytest.raises(UnknownOpError, match="nope") as ei:
             client._call({"op": "nope"})
+        assert ei.value.op == "nope"
         # still alive after the error reply
         assert client.stats()["router"]["routed"] == 5
     finally:
@@ -366,9 +371,11 @@ def test_router_drain_and_replica_drain(model_and_params):
     client = ServingClient("127.0.0.1", router.port)
     try:
         rng = np.random.default_rng(5)
-        # drain replica r0: everything new must land on r1
-        reply = client._call({"op": "drain", "replica": "r0"})
-        assert reply["ok"] == 1 and reply["replica"] == "r0"
+        # drain replica r0 through the public client API (the wire
+        # field the wire-contract pass tracks): everything new must
+        # land on r1
+        reply = client.drain(replica="r0")
+        assert reply == {"active": 0, "queued": 0}
         for _ in range(4):
             p = rng.integers(0, 64, size=6).astype(np.int32)
             rid = client.generate(p, max_new_tokens=4)

@@ -377,8 +377,16 @@ def test_server_rejects_bad_requests():
         client = ServingClient("127.0.0.1", server.port)
         with pytest.raises(RuntimeError, match="max_len"):
             client.generate(list(range(40)), max_new_tokens=20)
-        with pytest.raises(RuntimeError, match="unknown op"):
+        # typed unknown-op rejection: the terminal dispatch arm answers
+        # {"error": "unknown_op", "op": ...} and the client raises the
+        # typed error (still a RuntimeError for untyped callers),
+        # echoing the rejected op — and the connection survives
+        from distkeras_tpu.serving import UnknownOpError
+        with pytest.raises(UnknownOpError, match="nope") as ei:
             client._call({"op": "nope"})
+        assert ei.value.op == "nope"
+        assert isinstance(ei.value, RuntimeError)
+        assert "active_slots" in client.stats()  # conn still alive
         client.close()
     finally:
         server.stop()
