@@ -316,7 +316,44 @@ def main():
     out.update(serve_speculative_bench())
     out.update(serve_router_bench())
     out.update(serve_pipeline_bench())
+    out.update(serve_tier_bench())
     print(json.dumps(out))
+
+
+def serve_tier_bench():
+    """Tiered-KV-cache numbers for the BENCH trajectory: prefix-hit
+    gain of the host-RAM spill tier over device-only on the
+    3x-capacity shared-prefix trace, tail ITL against the all-resident
+    reference, and swap traffic. Self-asserts are off
+    (``checks=False``) and errors are folded into the JSON, same
+    policy as the other serving lines."""
+    import os
+    import sys
+
+    sys.path.insert(
+        0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "benchmarks"))
+    try:
+        import serve_bench
+
+        r = serve_bench.bench_host_tier(smoke=True, checks=False)
+        return {
+            "serve_tier_hit_gain": r["hit_gain"],
+            "serve_tier_hit_fraction": r["tier_hit_fraction"],
+            "serve_tier_device_hit_fraction": r["device_hit_fraction"],
+            "serve_tier_itl_ms_p99": r["tier_itl_ms_p99"],
+            "serve_tier_resident_itl_ms_p99": r["resident_itl_ms_p99"],
+            "serve_tier_tokens_per_sec": r["tier_tokens_per_sec"],
+            "serve_tier_swap_in_mb_s": r["swap_in_mb_s"],
+            "serve_tier_demotions": r["demotions"],
+            "serve_tier_restores": r["restores"],
+            "serve_tier_restore_wait_ms_p50":
+                r["restore_wait_ms"]["p50"],
+            "serve_tier_parity": r["parity"],
+            "serve_tier_config": r["config"],
+        }
+    except Exception as e:  # error-folded: a tier regression must land
+        return {"serve_tier_error": f"{type(e).__name__}: {e}"}
 
 
 def serve_pipeline_bench():

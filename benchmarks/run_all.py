@@ -450,9 +450,35 @@ def config13():
     }))
 
 
+def config14():
+    """Tiered KV cache: host-RAM spill tier under the block pool —
+    prefix_hit_fraction on a 3x-device-capacity shared-prefix trace,
+    host tier vs device-only vs all-resident (benchmarks/serve_bench.py
+    --host-tier; the --smoke variant self-asserts >=2x hit fraction,
+    bit-identical streams, zero steady-state recompiles, and restore
+    waits hidden against the all-resident ITL)."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import serve_bench
+
+    out = serve_bench.bench_host_tier(smoke=True)
+    print(json.dumps({
+        "config": 14, "metric": "serving_host_tier_hit_gain",
+        "value": out["hit_gain"],
+        "unit": "x (prefix_hit_fraction, tier / device-only)",
+        "tier_hit_fraction": out["tier_hit_fraction"],
+        "device_hit_fraction": out["device_hit_fraction"],
+        "tier_itl_ms_p99": out["tier_itl_ms_p99"],
+        "resident_itl_ms_p99": out["resident_itl_ms_p99"],
+        "swap_in_mb_s": out["swap_in_mb_s"],
+        "restores": out["restores"],
+        "model": out["config"],
+        "data": "synthetic-tiered-shared-prefix-trace",
+    }))
+
+
 CONFIGS = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5,
            6: config6, 7: config7, 8: config8, 9: config9, 10: config10,
-           11: config11, 12: config12, 13: config13}
+           11: config11, 12: config12, 13: config13, 14: config14}
 
 
 def main():

@@ -24,6 +24,7 @@ stats/metrics aggregation in :mod:`fleet`.
 from distkeras_tpu.serving.engine import ServingEngine  # noqa: F401
 from distkeras_tpu.serving.kvpool import (  # noqa: F401
     BlockPool,
+    HostBlockPool,
     OutOfBlocksError,
 )
 from distkeras_tpu.serving.prefix import (  # noqa: F401
@@ -57,6 +58,7 @@ __all__ = [
     "ServingEngine",
     "DEFAULT_PREFILL_CHUNK",
     "BlockPool",
+    "HostBlockPool",
     "OutOfBlocksError",
     "PrefixMatch",
     "RadixPrefixIndex",

@@ -92,7 +92,7 @@ from distkeras_tpu.models.transformer import filter_logits, sample_tokens
 from distkeras_tpu.telemetry.flight import FlightRecorder
 from distkeras_tpu.telemetry.runtime import MemoryWatermarks, recompiles
 from distkeras_tpu.telemetry.slo import StallWatchdog
-from distkeras_tpu.serving.kvpool import BlockPool
+from distkeras_tpu.serving.kvpool import BlockPool, HostBlockPool
 from distkeras_tpu.serving.prefix import RadixPrefixIndex
 from distkeras_tpu.serving.scheduler import (
     DEFAULT_PREFILL_CHUNK,
@@ -789,6 +789,55 @@ def _paged_tick_fn(dm_paged, cfgs, ctx: Optional[_ShardCtx] = None):
     return tick
 
 
+@functools.lru_cache(maxsize=32)
+def _gather_block_fn(blk_leaf_idx):
+    """Compiled block gather for demotion: slice one physical block's
+    rows out of every block-major paged cache leaf (K, V, int8 scales).
+    ``blk_leaf_idx`` is the tuple of flattened-leaf indices whose
+    leading axis is the block axis — precomputed once per engine so the
+    traced body carries no shape probing. NOT donated: the cache must
+    survive (the block's contents are being copied out, not moved).
+    Under a mesh the leaves arrive sharded along the KV-head axis; the
+    host-side ``np.asarray`` of the outputs assembles the GLOBAL view,
+    so the host tier always stores unsharded blocks (mesh-agnostic —
+    the restore upload re-shards onto whatever mesh is current)."""
+
+    @jax.jit
+    def gather(cache, blk):
+        recompiles.note("serve.gather_block")
+        leaves = jax.tree.leaves(cache)
+        return [leaves[i][blk] for i in blk_leaf_idx]
+
+    return gather
+
+
+@functools.lru_cache(maxsize=32)
+def _restore_blocks_fn(blk_leaf_idx):
+    """Compiled batched restore upload: scatter up to ``R`` demoted
+    blocks' host contents into their destination blocks across every
+    block-major cache leaf. ``R`` is the scheduler's ``restore_budget``
+    (a fixed compiled width — short batches pad with destination 0, the
+    reserved trash block, so restore count variation never recompiles).
+    One dispatch per tick, issued from the plan body BEFORE the tick's
+    compute: the upload is asynchronous and overlaps whatever is still
+    in flight, and the cache data dependency guarantees every later
+    tick observes the restored bytes — no explicit completion sync.
+    Unsharded host arrays re-shard onto the cache's sharding here (the
+    TP reshard-on-upload path)."""
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def restore(cache, stacked, dsts):
+        recompiles.note("serve.restore_blocks")
+        leaves, treedef = jax.tree.flatten(cache)
+        for j, i in enumerate(blk_leaf_idx):
+            leaves[i] = leaves[i].at[dsts].set(
+                stacked[j].astype(leaves[i].dtype)
+            )
+        return jax.tree.unflatten(treedef, leaves)
+
+    return restore
+
+
 @functools.partial(jax.jit, donate_argnums=(0,))
 def _copy_block(cache, src, dst):
     """Copy-on-write: duplicate physical block ``src`` into ``dst``
@@ -813,6 +862,13 @@ class _SlotState:
     # while decoding is False and DECODING after its last chunk landed.
     pending: Optional[np.ndarray] = None
     decoding: bool = True
+    # tiered KV cache: (host handle, prompt-token offset) pairs this
+    # row still waits on — non-None marks the RESTORING state: the row
+    # holds its slot and chain but ticks over it idle (valid 0, RNG
+    # untouched, NO token-budget charge) until the engine's batched
+    # restore uploads land, then flips to PREFILLING and streams its
+    # uncached suffix like any other admission
+    restoring: Optional[List[tuple]] = None
     admit_seq: int = 0  # admission order: prefill budget is dealt FIFO
     admit_t: float = 0.0  # monotonic admission time (prefill span)
     # speculative decoding (engine.spec): the row's emitted-but-unfed
@@ -897,6 +953,22 @@ class ServingEngine:
         prefix-cache headroom.
       prefix_cache: set False to disable radix prefix sharing (every
         prompt fully prefills; blocks free immediately at finish).
+      host_blocks: capacity (in KV blocks) of the host-RAM spill tier
+        under the block pool. With a tier, evicting a cached
+        unreferenced block DEMOTES its contents to pinned host memory
+        (radix node re-keyed ``device -> host``) instead of discarding
+        them, and a prefix hit on a demoted entry admits the request
+        into a RESTORING slot state: its blocks are uploaded back
+        asynchronously from the plan bodies — batched per tick, capped
+        by the scheduler's ``restore_budget`` so restores never starve
+        decode, overlapped with in-flight device compute — and the row
+        flips to PREFILLING (charging the token budget only then) once
+        every block is resident. Multiplies effective prefix-cache
+        capacity by roughly ``host_blocks / num_blocks`` at fixed
+        device memory; token streams stay bit-identical to the
+        tier-less engine (restored bytes are the demoted bytes).
+        Requires ``paged=True``, ``prefix_cache=True``, and chunked
+        prefill. ``None`` (default) disables the tier.
       prefill_chunk: Sarathi-style chunked prefill (the default, C=64):
         an admitted prompt streams into its slot C tokens at a time
         *inside* the decode tick — one fused ``[S, C]`` dispatch
@@ -1005,6 +1077,7 @@ class ServingEngine:
                  paged: bool = False, block_size: int = 16,
                  num_blocks: Optional[int] = None,
                  prefix_cache: bool = True,
+                 host_blocks: Optional[int] = None,
                  prefill_chunk: Optional[int] = DEFAULT_PREFILL_CHUNK,
                  flight=True, flight_capacity: int = 512,
                  postmortem_dir: str = "/tmp",
@@ -1156,8 +1229,28 @@ class ServingEngine:
                 # raise num_blocks for prefix-cache headroom beyond what
                 # finished requests leave behind
                 num_blocks = BlockPool.RESERVED + slots * self._max_blocks
+            self.host = None
+            if host_blocks is not None:
+                if host_blocks < 1:
+                    raise ValueError(
+                        f"host_blocks must be >= 1; got {host_blocks}"
+                    )
+                if not prefix_cache:
+                    raise ValueError(
+                        "the host tier spills the radix prefix cache — "
+                        "host_blocks requires prefix_cache=True"
+                    )
+                if prefill_chunk is None:
+                    raise ValueError(
+                        "host-tier restores ride the chunked mixed "
+                        "tick's plan bodies — host_blocks requires "
+                        "chunked prefill (prefill_chunk is not None)"
+                    )
+                self.host = HostBlockPool(host_blocks, block_size,
+                                          registry=self.registry)
             self.pool = BlockPool(num_blocks, block_size,
-                                  registry=self.registry)
+                                  registry=self.registry,
+                                  host_tier=self.host)
             self.prefix = (RadixPrefixIndex(block_size)
                            if prefix_cache else None)
             paged_kw = dict(
@@ -1195,9 +1288,26 @@ class ServingEngine:
                 (slots, self._max_blocks), np.int32
             )
             self._seq_lens = np.zeros((slots,), np.int32)
+            # tiered KV cache: flattened-leaf indices of the
+            # block-major cache leaves (the demote gather / restore
+            # scatter operate on exactly these), the FIFO queue of
+            # (handle, dst block) uploads not yet issued, and the
+            # handle -> dst map of every queued-or-issued restore (a
+            # concurrent admission hitting the same demoted chunk
+            # shares the dst instead of uploading twice)
+            self._blk_leaf_idx = tuple(
+                i for i, leaf in enumerate(jax.tree.leaves(self._cache))
+                if leaf.ndim >= 2 and leaf.shape[0] == num_blocks
+            )
         else:
+            if host_blocks is not None:
+                raise ValueError(
+                    "the host tier lives under the paged BlockPool — "
+                    "host_blocks requires paged=True"
+                )
             self.pool = None
             self.prefix = None
+            self.host = None
             tp_kw = ({"tp_size": self.tp, "tp_axis": tp_axis}
                      if mesh is not None else {})
             self._dm_slot = self.model.clone(
@@ -1293,6 +1403,14 @@ class ServingEngine:
         # counters are the process-cumulative twins)
         self.draft_tokens_proposed = 0
         self.draft_tokens_accepted = 0
+        # tiered KV cache accounting (per-engine; the HostBlockPool
+        # owns the registry twins) + the restore pipeline state
+        self._restore_queue: deque = deque()
+        self._inflight_restores: dict = {}
+        self.demotions = 0
+        self.restores = 0
+        self._tick_demoted = 0
+        self._tick_restored = 0
 
     def _init_mesh_ctx(self):
         """Shard the device-side engine state onto the mesh and build
@@ -1434,6 +1552,14 @@ class ServingEngine:
         self._m_prompt_tokens = reg.counter(
             "serving_prompt_tokens_total",
             "prompt tokens across admitted requests (hit + prefilled)")
+        # tiered KV cache (host-RAM spill under the block pool): how
+        # long a RESTORING row waited from admission until its last
+        # demoted block was resident again — the latency the pipelined
+        # restore overlap exists to hide behind in-flight ticks
+        self._m_restore_wait = reg.histogram(
+            "serving_restore_wait_ms",
+            "RESTORING-row admission to last host-tier block resident "
+            "(ms)")
         # runtime introspection (PR 5): recompiles are process-global
         # (jit trace caches are), so the gauge mirrors the shared
         # counter; memory gauges are sampled every few ticks
@@ -1747,30 +1873,86 @@ class ServingEngine:
         able to allocate (prefix hits only count as savings while their
         blocks are pinned by live references — an unreferenced cached
         block could be evicted by a peer admission before this request
-        reaches it), and the blocks obtainable without touching live
-        data (free + unreferenced cached, excluding this request's own
-        hit chain)."""
+        reaches it; a HOST hit saves nothing, its restore destination
+        is a fresh block, except where an in-flight restore of the same
+        chunk already owns a live dst this request will share), and the
+        blocks obtainable without touching live data (free +
+        unreferenced cached, excluding this request's own hit chain)."""
         total = self._blocks_for(req)
         if self.prefix is None:
             return total, self.pool.free_count()
         m = self.prefix.match(req.prompt)
         hit_live = sum(1 for b in m.blocks if self.pool.ref[b] > 0)
+        reused = sum(1 for h in m.host if h in self._inflight_restores)
         avail = self.pool.free_count() + self.prefix.evictable_count(
             self.pool.ref, exclude=m.blocks
         )
-        return total - hit_live, avail
+        return total - hit_live - reused, avail
 
     def _alloc_blocks(self, n: int, keep=()) -> List[int]:
         """Allocate ``n`` blocks, evicting LRU unreferenced prefix
         blocks as needed (``keep`` protects a hit chain about to be
-        reused). Admission guarantees this succeeds for admitted
-        requests; OutOfBlocksError here means admission was bypassed."""
+        reused). With a host tier the eviction DEMOTES: the victim's
+        contents move to pinned host memory and its radix node is
+        re-keyed ``device -> host``, so the prefix stays matchable.
+        Admission guarantees this succeeds for admitted requests;
+        OutOfBlocksError here means admission was bypassed."""
         while self.pool.free_count() < n and self.prefix is not None:
-            blk = self.prefix.evict_lru(self.pool.ref, exclude=keep)
-            if blk is None:
+            # batch one round of victims (bottom-up peeking can't climb
+            # past a still-registered device child, so a round picks
+            # sibling leaves; the outer loop climbs after they're gone)
+            need = n - self.pool.free_count()
+            victims: List[int] = []
+            ex = set(keep)
+            while len(victims) < need:
+                blk = self.prefix.peek_evictable(self.pool.ref,
+                                                 exclude=ex)
+                if blk is None:
+                    break
+                victims.append(blk)
+                ex.add(blk)
+            if not victims:
                 break
-            self.pool.evict(blk)
+            if self.host is not None:
+                self._demote_blocks(victims)
+            else:
+                for blk in victims:
+                    self.prefix.remove_block(blk)
+            for blk in victims:
+                self.pool.evict(blk)
         return self.pool.alloc(n)
+
+    def _demote_blocks(self, blks: List[int]):
+        """Demote a round of about-to-be-evicted prefix-cached blocks:
+        gather each one's K/V (+ int8 scales) off the device —
+        unsharded, whatever the mesh — memcpy into the host pool, and
+        re-key the radix nodes to the returned handles; the caller then
+        frees the device blocks (:meth:`BlockPool.evict` returns each
+        id, pinning the demotion to exactly the block released). Off
+        the hot path: runs only when an allocation must reclaim
+        (admission), never per tick — and ALL gathers dispatch
+        asynchronously before the first host copy blocks, so a round
+        pays one device round trip, not one per block. The host pool
+        may LRU-evict older entries to make room — their radix subtrees
+        unlink, cascading entry discards — or refuse when everything it
+        holds is pinned by in-flight restores, in which case the
+        demotion degrades to the tier-less plain eviction (bounded host
+        footprint beats an unbounded one)."""
+        gather = _gather_block_fn(self._blk_leaf_idx)
+        outs = [gather(self._cache, jnp.int32(blk)) for blk in blks]
+        for blk, out in zip(blks, outs):
+            leaves = [np.asarray(x) for x in out]
+            handle, lru_evicted = self.host.put(leaves)
+            for h in lru_evicted:
+                for hh in self.prefix.drop_host(h):
+                    self.host.discard(hh)
+            if handle is None:
+                for hh in self.prefix.remove_block(blk):
+                    self.host.discard(hh)
+                continue
+            self.prefix.demote(blk, handle)
+            self.demotions += 1
+            self._tick_demoted += 1
 
     def _prefill_into(self, slot: int, req: Request):
         now = time.monotonic()
@@ -1811,31 +1993,67 @@ class ServingEngine:
 
     def _paged_attach_blocks(self, req: Request):
         """Shared paged admission bookkeeping: radix-match the prompt,
-        reuse the matched prefix blocks (refcount bump, zero prefill),
-        copy-on-write a partially-shared block if the prompt diverges
-        mid-block, allocate the rest. Returns ``(chain, cached)`` — the
-        row's physical block chain and how many leading prompt tokens
-        are already served by the cache."""
+        reuse the matched device-resident prefix blocks (refcount bump,
+        zero prefill), queue restore uploads for the matched
+        HOST-resident chunks (each gets a fresh destination block the
+        row owns — or shares the dst of an already-in-flight restore of
+        the same chunk), copy-on-write a partially-shared block if the
+        prompt diverges mid-block on a device frontier, allocate the
+        rest. Returns ``(chain, cached, restoring)`` — the row's
+        physical block chain, how many leading prompt tokens are served
+        by the cache (device + host hits + COW), and the ordered
+        ``(handle, token_offset)`` restore list (empty = the row may
+        prefill immediately; non-empty = RESTORING until the uploads
+        land)."""
         bs = self.block_size
         m = self.prefix.match(req.prompt) if self.prefix else None
         shared = list(m.blocks) if m else []
+        host_hits = list(m.host) if m else []
         total = self._blocks_for(req)
-        # len(shared)*bs <= Tp-1 < total*bs, so at least one fresh block
-        fresh = self._alloc_blocks(total - len(shared), keep=shared)
-        chain = shared + fresh
+        # pin the host entries FIRST: the allocation below may demote
+        # more blocks, and the host pool's LRU must not evict an entry
+        # this admission is about to restore from
+        reuse = {}
+        for h in host_hits:
+            if h in self._inflight_restores:
+                reuse[h] = self._inflight_restores[h]
+            else:
+                self.host.pin(h)
+            self.host.touch(h)
+        keep = shared + list(reuse.values())
+        # (len(shared)+len(host))*bs <= Tp-1 < total*bs, so at least
+        # one fresh block beyond the hit chain
+        fresh = self._alloc_blocks(
+            total - len(shared) - len(reuse), keep=keep
+        )
+        fi = 0
+        chain = list(shared)
+        restoring: List[tuple] = []
+        for i, h in enumerate(host_hits):
+            dst = reuse.get(h)
+            if dst is None:
+                dst = fresh[fi]
+                fi += 1
+                self._inflight_restores[h] = dst
+                self._restore_queue.append((h, dst))
+            chain.append(dst)
+            restoring.append((h, (len(shared) + i) * bs))
+        chain += fresh[fi:]
         self.pool.incref(chain)
-        cached = len(shared) * bs
+        cached = (len(shared) + len(host_hits)) * bs
         if m is not None and m.cow is not None:
             # the prompt shares j tokens of a cached block, then
             # diverges: copy that block into this row's first fresh
             # block — the row's writes land in its own copy, the shared
-            # original stays immutable under other tables
+            # original stays immutable under other tables. (COW is only
+            # offered from a device frontier, so host_hits is empty and
+            # fresh[0] is the first block past the shared chain.)
             src, j = m.cow
             self._cache = _copy_block(
                 self._cache, jnp.int32(src), jnp.int32(fresh[0])
             )
             cached += j
-        return chain, cached
+        return chain, cached, restoring
 
     def _paged_prefill_into(self, slot: int, req: Request, now: float):
         """Admit one request into a paged slot (monolithic mode):
@@ -1844,7 +2062,9 @@ class ServingEngine:
         if any(st is not None and st.decoding for st in self._slots):
             self._m_decode_stalls.inc()
         Tp = int(req.prompt.size)
-        chain, cached = self._paged_attach_blocks(req)
+        # monolithic mode never has a host tier (the constructor gates
+        # host_blocks on chunked prefill), so restoring is always empty
+        chain, cached, _ = self._paged_attach_blocks(req)
         suffix = jnp.asarray(req.prompt[cached:], jnp.int32)[None]
         table = np.zeros((1, self._max_blocks), np.int32)
         table[0, :len(chain)] = chain
@@ -1890,8 +2110,9 @@ class ServingEngine:
         shared span — only the suffix goes through chunks."""
         Tp = int(req.prompt.size)
         cached = 0
+        restoring: List[tuple] = []
         if self.paged:
-            chain, cached = self._paged_attach_blocks(req)
+            chain, cached, restoring = self._paged_attach_blocks(req)
             tables = self._block_tables.copy()
             tables[slot, :] = 0
             tables[slot, :len(chain)] = chain
@@ -1910,7 +2131,8 @@ class ServingEngine:
             req=req, remaining=req.max_new_tokens, blocks=chain,
             cached_tokens=cached,
             pending=np.asarray(req.prompt[cached:], np.int32),
-            decoding=False, admit_seq=self._admit_seq, admit_t=now,
+            decoding=False, restoring=restoring or None,
+            admit_seq=self._admit_seq, admit_t=now,
         )
         if self.spec:
             # speculative state: the drafter conditions on the FULL
@@ -1932,6 +2154,108 @@ class ServingEngine:
         if self.paged:
             self.prefix_hit_tokens += cached
             self._m_prefix_hit.inc(cached)
+
+    # -- tiered KV cache (host-RAM spill restores) --------------------------
+
+    def _issue_restores(self):
+        """Upload up to ``restore_budget`` queued host-tier blocks back
+        into the device pool in ONE batched scatter dispatch. Called
+        from the plan bodies, BEFORE the tick's compute is dispatched:
+        the upload is asynchronous, overlaps whatever is still in
+        flight (the pipelined loop's whole point), and the cache data
+        dependency orders it ahead of every later tick — nothing here
+        reads a device value back, so the plan stays sync-free. Rows
+        whose last awaited block lands flip RESTORING → PREFILLING (and
+        only then start charging the scheduler's token budget); the
+        handle's radix node is promoted back to device residency at its
+        destination block, so concurrent requests share the restored
+        copy like any other cached prefix. A handle whose host entry
+        vanished (the defensive race) falls back to seeded replay:
+        :meth:`_restore_fallback` rewinds the waiting rows to recompute
+        the span — deterministic prefill writes the identical bytes
+        into the identical blocks."""
+        n = self.scheduler.plan_restore(len(self._restore_queue))
+        if n <= 0:
+            return
+        R = self.scheduler.restore_budget
+        dsts = np.zeros((R,), np.int32)  # pad -> block 0 (trash)
+        stacked = None
+        done: List[tuple] = []
+        while self._restore_queue and len(done) < n:
+            h, dst = self._restore_queue.popleft()
+            leaves = self.host.take(h)
+            if leaves is None:
+                self._restore_fallback(h)
+                continue
+            if stacked is None:
+                stacked = [np.zeros((R,) + a.shape, a.dtype)
+                           for a in leaves]
+            for j, a in enumerate(leaves):
+                stacked[j][len(done)] = a
+            dsts[len(done)] = dst
+            done.append((h, dst))
+        if not done:
+            return
+        restore_f = _restore_blocks_fn(self._blk_leaf_idx)
+        self._cache = restore_f(self._cache, stacked,
+                                jnp.asarray(dsts))
+        now = time.monotonic()
+        for h, dst in done:
+            del self._inflight_restores[h]
+            self.prefix.promote(h, dst)
+        self.restores += len(done)
+        self._tick_restored += len(done)
+        for st in self._slots:
+            if st is None or st.restoring is None:
+                continue
+            still = [(h, off) for h, off in st.restoring
+                     if h in self._inflight_restores]
+            if len(still) == len(st.restoring):
+                continue
+            if still:
+                st.restoring = still
+                continue
+            # every block resident: the row becomes an ordinary
+            # PREFILLING admission (its pending suffix enters the
+            # budget deal next plan); restore latency ends here
+            st.restoring = None
+            self._m_restore_wait.observe((now - st.admit_t) * 1e3)
+
+    def _restore_fallback(self, handle: int):
+        """A queued restore's host entry is gone (the tier lost a race
+        with its own LRU eviction — its radix node is already
+        unlinked): seeded replay. Every row waiting on the handle is
+        rewound to recompute from that chunk's token offset on — its
+        pending queue regrows and the ordinary chunked prefill rewrites
+        the SAME chain blocks at the same absolute positions, so a peer
+        row still restoring a LATER shared chunk into one of those
+        blocks observes bit-identical bytes either way (deterministic
+        compute). Later chunks the row awaited are dropped from its
+        wait list too: the recompute covers them, and their own queued
+        restores — if other rows still want them — proceed
+        independently. The engine-side prefix-hit attribution is
+        corrected; the monotonic registry counter keeps its
+        at-admission count (documented slack on a defensive path)."""
+        self._inflight_restores.pop(handle, None)
+        lens = None
+        for s, st in enumerate(self._slots):
+            if st is None or st.restoring is None:
+                continue
+            offs = [off for h, off in st.restoring if h == handle]
+            if not offs:
+                continue
+            new_cached = offs[0]
+            self.prefix_hit_tokens -= st.cached_tokens - new_cached
+            st.cached_tokens = new_cached
+            st.pending = st.req.prompt[new_cached:]
+            st.restoring = [(h, off) for h, off in st.restoring
+                            if off < new_cached] or None
+            if lens is None:
+                # copy-and-rebind (aliasing hazard, see _decode_tick)
+                lens = self._seq_lens.copy()
+            lens[s] = new_cached
+        if lens is not None:
+            self._seq_lens = lens
 
     def _mixed_tick(self):
         """One fused mixed prefill/decode tick, sync mode: plan and
@@ -1964,8 +2288,14 @@ class ServingEngine:
         dispatch ONE ``[S, C]`` valid-length dispatch without touching
         the device results. When no prefill token was dealt the shape
         shrinks to the plain ``[S, 1]`` decode tick. Returns the
-        in-flight record :meth:`_reconcile` later materializes."""
+        in-flight record :meth:`_reconcile` later materializes.
+        RESTORING rows (host-tier uploads still in flight) are planned
+        as idle — valid 0, no budget charge, RNG untouched; their
+        restore uploads are issued here, BEFORE the tick's dispatch, so
+        the transfer overlaps the in-flight compute."""
         t_plan0 = time.perf_counter()
+        if self.host is not None:
+            self._issue_restores()
         S = self.slots
         cfgs = tuple(
             (st.req.temperature, st.req.top_k, st.req.top_p)
@@ -1975,7 +2305,7 @@ class ServingEngine:
         n_dec = sum(1 for st in self._slots if st and st.decoding)
         pre = sorted(
             ((s, st) for s, st in enumerate(self._slots)
-             if st and not st.decoding),
+             if st and not st.decoding and st.restoring is None),
             key=lambda p: p[1].admit_seq,
         )
         takes = self.scheduler.plan_prefill(
@@ -1998,6 +2328,10 @@ class ServingEngine:
                 valid[s] = 1
                 sample_mask[s] = 1
                 rows[s] = ("dec", st)
+            # else: PREFILLING rows are dealt below; RESTORING rows
+            # stay at valid 0 / sample 0 — the row writes nothing, its
+            # cursor holds at the cached span, and its RNG chain is
+            # untouched until its first real chunk
         for (s, st), take in zip(pre, takes):
             flipped = False
             if take > 0:
@@ -2259,8 +2593,12 @@ class ServingEngine:
         rollback. Acceptance-length variation changes only traced
         values — steady state compiles exactly two shapes (``[S,
         k+1]`` all-decode, ``[S, max(C, k+1)]`` with chunks), like the
-        non-speculative mixed tick."""
+        non-speculative mixed tick. Host-tier restore uploads are
+        issued first, same as the plain mixed plan; RESTORING rows are
+        planned idle."""
         t_plan0 = time.perf_counter()
+        if self.host is not None:
+            self._issue_restores()
         S, k = self.slots, self.spec_k
         cfgs = tuple(
             (st.req.temperature, st.req.top_k, st.req.top_p)
@@ -2269,7 +2607,7 @@ class ServingEngine:
         )
         pre = sorted(
             ((s, st) for s, st in enumerate(self._slots)
-             if st and not st.decoding),
+             if st and not st.decoding and st.restoring is None),
             key=lambda p: p[1].admit_seq,
         )
         dec = [(s, st) for s, st in enumerate(self._slots)
@@ -2623,6 +2961,10 @@ class ServingEngine:
             elif st.decoding:
                 out.append({"rid": st.req.rid, "state": "decode",
                             "remaining": st.remaining})
+            elif st.restoring is not None:
+                out.append({"rid": st.req.rid, "state": "restore",
+                            "pending": len(st.restoring),
+                            "remaining": st.remaining})
             else:
                 out.append({"rid": st.req.rid, "state": "prefill",
                             "pending": int(st.pending.size),
@@ -2735,8 +3077,16 @@ class ServingEngine:
                                   else {"in_use": self.pool.in_use_count(),
                                         "free": self.pool.free_count()})
                 snap["prefix_hit_tokens"] = self.prefix_hit_tokens
+                if self.host is not None:
+                    # tiered KV cache: per-tick swap activity + the
+                    # host pool's current footprint
+                    snap["demoted"] = self._tick_demoted
+                    snap["restored"] = self._tick_restored
+                    snap["host_blocks"] = self.host.count()
             self.flight.record(snap)
         self._flight_ns += time.perf_counter_ns() - t0
+        self._tick_demoted = 0
+        self._tick_restored = 0
 
     def stats(self) -> dict:
         """Counters + latency percentiles (TTFT and per-token, ms) for
@@ -2834,4 +3184,21 @@ class ServingEngine:
                     if self.prompt_tokens else 0.0
                 ),
             })
+            if self.host is not None:
+                # tiered KV cache: the router's spill gate reads
+                # host_blocks_cached next to blocks_reclaimable — a
+                # replica whose device pool looks tight but whose host
+                # tier holds the prefixes is one swap-in away from a
+                # hit, not saturated
+                hs = self.host.stats()
+                out.update({
+                    "host_blocks_cached": hs["blocks"],
+                    "host_bytes": hs["bytes"],
+                    "block_demotions": self.demotions,
+                    "block_restores": self.restores,
+                    "restore_wait_ms": {
+                        "p50": self._m_restore_wait.percentile(50),
+                        "p99": self._m_restore_wait.percentile(99),
+                    },
+                })
         return out

@@ -453,7 +453,16 @@ class Router:
         # cached-unreferenced (evictable) ones. Falls back to the bare
         # free count against older replicas that don't report it.
         free = s.get("blocks_reclaimable", s.get("blocks_free"))
-        return free is not None and free <= self.spill_min_free_blocks
+        if free is None:
+            return False
+        # tiered replicas: a demoted prefix block is one swap-in away
+        # from a hit — spilling an affine request off a replica whose
+        # device pool is merely churning (but whose host tier holds
+        # the prefixes) would destroy the locality the tier exists to
+        # preserve, so host-cached capacity counts before the pool is
+        # declared saturated
+        free += s.get("host_blocks_cached", 0)
+        return free <= self.spill_min_free_blocks
 
     def _choose(self, prompt, exclude: Set[str],
                 ) -> Tuple[Replica, str]:

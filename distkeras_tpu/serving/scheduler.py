@@ -162,13 +162,21 @@ class FIFOScheduler:
         default chunk of prefill tokens per tick) and keeps bounding
         admissions per :meth:`pop_admissible` for engines running the
         legacy monolithic prefill.
+      restore_budget: host-tier KV blocks the engine may upload back
+        to the device per tick (:meth:`plan_restore`). Restores ride
+        the plan/dispatch boundary and overlap device compute, but the
+        host side of each upload still costs tick time — the cap keeps
+        a burst of RESTORING admissions from starving the live decode
+        streams, the same role ``tick_token_budget`` plays for prompt
+        chunks. Defaults to 4 blocks/tick.
     """
 
     def __init__(self, max_queue_depth: int = 256,
                  tick_token_budget: Optional[int] = None,
                  tracer: Optional["telemetry.Tracer"] = None,
                  registry: Optional["telemetry.MetricRegistry"] = None,
-                 max_prefills_per_tick: Optional[int] = None):
+                 max_prefills_per_tick: Optional[int] = None,
+                 restore_budget: int = 4):
         if max_queue_depth < 1:
             raise ValueError(
                 f"max_queue_depth must be >= 1; got {max_queue_depth}"
@@ -196,8 +204,13 @@ class FIFOScheduler:
             raise ValueError(
                 f"tick_token_budget must be >= 1; got {tick_token_budget}"
             )
+        if restore_budget < 1:
+            raise ValueError(
+                f"restore_budget must be >= 1; got {restore_budget}"
+            )
         self.max_queue_depth = max_queue_depth
         self.tick_token_budget = tick_token_budget
+        self.restore_budget = restore_budget
         # legacy admissions-per-pop cap; None = free slots only
         self.max_prefills_per_tick = max_prefills_per_tick
         self._q: deque = deque()
@@ -418,6 +431,16 @@ class FIFOScheduler:
             widths.append(grant)
             remain -= grant
         return takes, widths
+
+    def plan_restore(self, pending: int) -> int:
+        """How many queued host-tier block restores one tick may issue:
+        ``min(pending, restore_budget)``. Restores are host→device
+        transfers, not budget tokens — they overlap in-flight device
+        compute — but issuing them still spends host plan time, so the
+        per-tick cap bounds what a burst of RESTORING admissions can
+        steal from live decode streams (a row waiting on blocks waits a
+        few more ticks; a decode stream never stalls)."""
+        return min(int(pending), self.restore_budget)
 
     def _expire(self, req: Request):
         """Finish a queued request whose deadline passed before a slot

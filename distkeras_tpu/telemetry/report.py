@@ -217,12 +217,13 @@ def report(path: str, trace: Optional[int] = None, top: int = 10,
 def _slot_cell(s) -> str:
     """One slot's state, compact: 'r17:D-3' = request 17 decoding with 3
     tokens left, 'r18:P+128' = prefilling with 128 prompt tokens
-    pending, '-' = idle."""
+    pending, 'r19:R+2' = RESTORING with 2 host-tier blocks still in
+    flight, '-' = idle."""
     if not s:
         return "-"
     state = s.get("state", "?")[:1].upper()
-    if state == "P":
-        return f"r{s.get('rid', '?')}:P+{s.get('pending', '?')}"
+    if state in ("P", "R"):
+        return f"r{s.get('rid', '?')}:{state}+{s.get('pending', '?')}"
     return f"r{s.get('rid', '?')}:{state}-{s.get('remaining', '?')}"
 
 
@@ -275,6 +276,9 @@ def report_flight(path: str, last: Optional[int] = None,
         if "blocks" in r:
             b = r["blocks"]
             extra += f"  blocks={b.get('in_use')}/{b.get('free')}free"
+        if "demoted" in r and (r.get("demoted") or r.get("restored")):
+            # tiered KV cache: blocks swapped out/in this tick
+            extra += f"  tier=-{r['demoted']}/+{r.get('restored', 0)}"
         if "draft_tokens" in r:
             # speculative tick: accepted/proposed draft tokens
             extra += (f"  spec={r.get('accepted_tokens')}"
@@ -321,6 +325,17 @@ def report_flight(path: str, last: Optional[int] = None,
             + (f"  pipeline_depth max {max(depth)}  "
                f"overrun_tokens {overrun}" if depth else "")
             + "\n"
+        )
+    if any("demoted" in r for r in ticks):
+        # tiered KV cache: total swap traffic across the retained
+        # window and the host pool's final footprint
+        demoted = sum(int(r.get("demoted", 0)) for r in ticks)
+        restored = sum(int(r.get("restored", 0)) for r in ticks)
+        host_now = next((r["host_blocks"] for r in reversed(ticks)
+                         if "host_blocks" in r), 0)
+        out.write(
+            f"host tier: {demoted} blocks demoted, {restored} "
+            f"restored, {host_now} resident at last tick\n"
         )
     worst = sorted(ticks, key=lambda r: float(r.get("tick_ms", 0.0)),
                    reverse=True)[:slow]

@@ -319,8 +319,13 @@ def test_hostsync_hoisted_readback_into_plan_body(tmp_path):
     pass pins _plan_dispatch_mixed."""
     e = _mutate(
         tmp_path, ENGINE,
-        "        t_plan0 = time.perf_counter()\n        S = self.slots",
         "        t_plan0 = time.perf_counter()\n"
+        "        if self.host is not None:\n"
+        "            self._issue_restores()\n"
+        "        S = self.slots",
+        "        t_plan0 = time.perf_counter()\n"
+        "        if self.host is not None:\n"
+        "            self._issue_restores()\n"
         "        _peek = np.asarray(self._last_logits)\n"
         "        S = self.slots")
     findings = analyze([e], passes=[HostSyncHazardPass()])
@@ -367,8 +372,13 @@ def test_hostsync_hazard_in_reached_helper(tmp_path):
 def test_hostsync_suppression_comment(tmp_path):
     e = _mutate(
         tmp_path, ENGINE,
-        "        t_plan0 = time.perf_counter()\n        S = self.slots",
         "        t_plan0 = time.perf_counter()\n"
+        "        if self.host is not None:\n"
+        "            self._issue_restores()\n"
+        "        S = self.slots",
+        "        t_plan0 = time.perf_counter()\n"
+        "        if self.host is not None:\n"
+        "            self._issue_restores()\n"
         "        _peek = np.asarray(self._rngs)  # analysis: host-sync-ok\n"
         "        S = self.slots")
     assert analyze([e], passes=[HostSyncHazardPass()]) == []
