@@ -317,7 +317,47 @@ def main():
     out.update(serve_router_bench())
     out.update(serve_pipeline_bench())
     out.update(serve_tier_bench())
+    out.update(serve_disagg_bench())
     print(json.dumps(out))
+
+
+def serve_disagg_bench():
+    """Prefill/decode-disaggregation numbers for the BENCH trajectory:
+    p99 TTFT and p99 ITL of the long-prompt-interference trace through
+    the 1-prefill + 2-decode migrating fleet vs the uniform mixed
+    baseline, migration counts/latency, and the eviction-race result.
+    Self-asserts are off (``checks=False``) and errors are folded into
+    the JSON, same policy as the other serving lines."""
+    import os
+    import sys
+
+    sys.path.insert(
+        0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "benchmarks"))
+    try:
+        import serve_bench
+
+        r = serve_bench.run_disagg(smoke=True, checks=False)
+        return {
+            "serve_disagg_itl_p99_reduction": r["itl_p99_reduction"],
+            "serve_disagg_ttft_p99_reduction": r["ttft_p99_reduction"],
+            "serve_disagg_itl_ms_p99": r["disagg_itl_ms_p99"],
+            "serve_disagg_baseline_itl_ms_p99": r["baseline_itl_ms_p99"],
+            "serve_disagg_ttft_ms_p99": r["disagg_ttft_ms_p99"],
+            "serve_disagg_baseline_ttft_ms_p99":
+                r["baseline_ttft_ms_p99"],
+            "serve_disagg_tokens_per_sec": r["disagg_tokens_per_sec"],
+            "serve_disagg_kv_migrations_ok": r["kv_migrations_ok"],
+            "serve_disagg_kv_migration_ms_p50":
+                (r["kv_migration_ms"] or {}).get("p50"),
+            "serve_disagg_race_streams_lost": r["race_streams_lost"],
+            "serve_disagg_parallel_capable": r["parallel_capable"],
+            "serve_disagg_parity": r["parity"],
+            "serve_disagg_config": r["config"],
+        }
+    except Exception as e:  # error-folded: a disagg regression must
+        # land as a worse number, not a dead BENCH line
+        return {"serve_disagg_error": f"{type(e).__name__}: {e}"}
 
 
 def serve_tier_bench():

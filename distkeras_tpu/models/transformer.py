@@ -248,6 +248,17 @@ class CausalSelfAttention(nn.Module):
     # VMEM; the gathered path materializes the whole [B, L, Hk, hd]
     # (dequantized!) view per call and stays the bit-parity reference.
     paged_kernel: str = "auto"
+    # chunked-prefill attend implementation (the mixed tick's T > 1
+    # shape): 'auto' (the splash-style Pallas kernel of
+    # ops.splash_prefill where the shape tiles on this backend — KV
+    # blocks beyond each row's diagonal skipped outright — else the
+    # dense masked reference), 'splash' (force; interpret mode
+    # off-TPU, the parity tests' lever), or 'gather' (force the dense
+    # reference). Serves BOTH decode cache layouts: the slot leaves
+    # directly, and the paged path's gathered view when the paged
+    # Pallas kernel did not take the call. Decode steps (T == 1) always
+    # take the dense path — that shape is its home turf.
+    prefill_kernel: str = "auto"
 
     _DENSE_MAX_T = 512  # short sequences: one fused dense block is fastest
 
@@ -265,6 +276,21 @@ class CausalSelfAttention(nn.Module):
         store = 1 if quant else jnp.dtype(self.dtype).itemsize
         return _pa.preferred(T, G, hd, self.page_block_size,
                              store_itemsize=store)
+
+    def _use_prefill_kernel(self, T, G, hd, L) -> bool:
+        """Resolve ``prefill_kernel`` for this call shape: 'auto'
+        defers to the splash kernel's preferred() gate (TPU + tileable
+        + a true chunk), 'splash' forces it (interpret mode off-TPU),
+        'gather' keeps the dense reference. Single-token decode steps
+        never take the kernel — skipping KV blocks buys nothing at
+        T == 1."""
+        if T < 2 or self.prefill_kernel == "gather":
+            return False
+        from distkeras_tpu.ops import splash_prefill as _sp
+
+        if self.prefill_kernel == "splash":
+            return True
+        return _sp.preferred(T, G, hd, L)
 
     def _paged_attend(self, q, k, v, block_tables, seq_lens,
                       valid_lens=None):
@@ -359,6 +385,16 @@ class CausalSelfAttention(nn.Module):
                     * view(vs.value)[..., None]).astype(self.dtype)
         else:
             keys, vals = view(ck.value), view(cv.value)
+        if self._use_prefill_kernel(T, G, hd, L):
+            # splash chunked prefill over the gathered view: identical
+            # absolute-position masks, KV tiles beyond each row's
+            # diagonal skipped (ops/splash_prefill.py); the dense
+            # attend below stays the bit-parity reference
+            from distkeras_tpu.ops.splash_prefill import (
+                splash_prefill_attention,
+            )
+
+            return splash_prefill_attention(q, keys, vals, seq_lens)
         scale = 1.0 / np.sqrt(hd)
         qg = q.reshape(B, T, Hk, G, hd)
         s = jnp.einsum(
@@ -474,6 +510,18 @@ class CausalSelfAttention(nn.Module):
             cv.value = put(cv.value, v.astype(self.dtype))
             keys, vals = ck.value, cv.value
         idx.value = cur + (T if valid_lens is None else valid_lens)
+        if self._use_prefill_kernel(T, G, hd, L):
+            # splash chunked prefill over the slot cache leaves: same
+            # per-row absolute-position masks as the dense attend below
+            # (which stays the bit-parity reference), KV tiles beyond
+            # each row's diagonal skipped (ops/splash_prefill.py)
+            from distkeras_tpu.ops.splash_prefill import (
+                splash_prefill_attention,
+            )
+
+            starts = (cur if self.slot_cursor
+                      else jnp.broadcast_to(cur, (B,)))
+            return splash_prefill_attention(q, keys, vals, starts)
         scale = 1.0 / np.sqrt(hd)
         qg = q.reshape(B, T, Hk, G, hd)
         s = jnp.einsum(
@@ -512,6 +560,11 @@ class CausalSelfAttention(nn.Module):
             raise ValueError(
                 "slot_cursor=True (per-row cache cursors) only makes "
                 "sense with decode=True"
+            )
+        if self.prefill_kernel not in ("auto", "splash", "gather"):
+            raise ValueError(
+                f"Unknown prefill_kernel '{self.prefill_kernel}'. "
+                "Known: auto, splash, gather"
             )
         if valid_lens is not None and not (self.slot_cursor or self.paged):
             raise ValueError(
@@ -693,6 +746,7 @@ class Block(nn.Module):
     page_block_size: int = 16
     num_pages: int = 0
     paged_kernel: str = "auto"  # paged attend: auto | pallas | gather
+    prefill_kernel: str = "auto"  # chunk attend: auto | splash | gather
 
     @nn.compact
     def __call__(self, x, block_tables=None, seq_lens=None,
@@ -710,6 +764,7 @@ class Block(nn.Module):
             page_block_size=self.page_block_size,
             num_pages=self.num_pages,
             paged_kernel=self.paged_kernel,
+            prefill_kernel=self.prefill_kernel,
         )(h, block_tables, seq_lens, valid_lens)
         h = nn.LayerNorm(dtype=self.dtype)(x)
         if self.moe_experts > 0:
@@ -810,6 +865,12 @@ class TransformerLM(nn.Module):
     # table, int8 dequant fused in VMEM), 'pallas' (force; interpret
     # mode off-TPU), 'gather' (the XLA gather+einsum reference)
     paged_kernel: str = "auto"
+    # chunked-prefill attend implementation (mixed-tick T > 1 shapes,
+    # both decode cache layouts): 'auto' (the splash-style Pallas
+    # kernel of ops/splash_prefill.py where the shape tiles on this
+    # backend — beyond-diagonal KV tiles skipped), 'splash' (force;
+    # interpret mode off-TPU), 'gather' (the dense masked reference)
+    prefill_kernel: str = "auto"
     # features_only=True returns the backbone's ln_f output [B, T, D]
     # instead of logits, for the fused chunked cross-entropy
     # (ops/fused_ce.py): the head matmul then happens INSIDE the loss,
@@ -922,6 +983,7 @@ class TransformerLM(nn.Module):
                 page_block_size=self.page_block_size,
                 num_pages=self.num_pages,
                 paged_kernel=self.paged_kernel,
+                prefill_kernel=self.prefill_kernel,
                 name=f"Block_{i}",
             )(x, block_tables, seq_lens, valid_lens)
         x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)

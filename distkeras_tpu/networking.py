@@ -130,6 +130,23 @@ def native_transport_active() -> bool:
 MAX_FRAME_BYTES = 1 << 33  # 8 GiB
 
 
+class FrameError(ConnectionError):
+    """A framed-msgpack frame violated the transport contract: its
+    header announced more bytes than the caller's ``max_bytes`` limit
+    (a corrupt/hostile header must not demand the allocation), or the
+    peer closed the connection mid-payload (a truncated frame must not
+    masquerade as a clean EOF — the pre-typed behavior, which made a
+    half-written KV payload look like an orderly shutdown). The
+    message always names the limit or the expected size; ``limit`` and
+    ``size`` carry them structurally. Subclasses ``ConnectionError``
+    so every existing drop-the-connection handler keeps working."""
+
+    def __init__(self, msg, limit=None, size=None):
+        super().__init__(msg)
+        self.limit = limit
+        self.size = size
+
+
 def _native_usable(sock: socket.socket):
     """The C data plane does raw blocking send/recv on the fd; a Python-level
     timeout puts the fd in non-blocking mode (EAGAIN mid-frame), so only use
@@ -154,20 +171,26 @@ def send_frame(sock: socket.socket, payload: bytes):
 def recv_frame(
     sock: socket.socket, max_bytes: int = MAX_FRAME_BYTES
 ) -> Optional[bytes]:
-    """One frame, or None on clean EOF. Frames over ``max_bytes`` raise
-    (and the caller should drop the connection) instead of allocating."""
+    """One frame, or None on clean EOF (before a header). Frames over
+    ``max_bytes`` raise :class:`FrameError` naming the limit instead of
+    allocating, and an EOF mid-frame raises it too (a truncated frame
+    is damage, not shutdown); callers drop the connection either way."""
     lib = _native_usable(sock)
     if lib:
         size = lib.dk_recv_frame_size(sock.fileno())
         if size < 0:
             return None
         if size > max_bytes:
-            raise ConnectionError(
-                f"frame of {size} bytes exceeds max_bytes={max_bytes}"
+            raise FrameError(
+                f"frame of {size} bytes exceeds max_bytes={max_bytes}",
+                limit=max_bytes, size=size,
             )
         buf = ctypes.create_string_buffer(size)
         if lib.dk_recv_exact(sock.fileno(), buf, size) != 0:
-            return None
+            raise FrameError(
+                f"truncated frame: peer closed mid-payload "
+                f"({size} bytes expected)", size=size,
+            )
         _RECV_FRAMES.inc()
         _RECV_BYTES.inc(size)
         return buf.raw
@@ -176,13 +199,20 @@ def recv_frame(
         return None
     (size,) = struct.unpack(">Q", header)
     if size > max_bytes:
-        raise ConnectionError(
-            f"frame of {size} bytes exceeds max_bytes={max_bytes}"
+        raise FrameError(
+            f"frame of {size} bytes exceeds max_bytes={max_bytes}",
+            limit=max_bytes, size=size,
         )
     data = _recv_exact_py(sock, size)
-    if data is not None:
-        _RECV_FRAMES.inc()
-        _RECV_BYTES.inc(size)
+    if data is None:
+        # EOF between a complete header and its payload: a torn frame,
+        # not a clean close — the typed error lets callers distinguish
+        raise FrameError(
+            f"truncated frame: peer closed mid-payload "
+            f"({size} bytes expected)", size=size,
+        )
+    _RECV_FRAMES.inc()
+    _RECV_BYTES.inc(size)
     return data
 
 

@@ -39,6 +39,7 @@ from distkeras_tpu.serving.scheduler import (  # noqa: F401
     Request,
     TokenStream,
 )
+from distkeras_tpu.networking import FrameError  # noqa: F401
 from distkeras_tpu.serving.server import (  # noqa: F401
     DISCONNECTED,
     LMServer,
@@ -68,6 +69,7 @@ __all__ = [
     "OverloadedError",
     "ServingConnectionError",
     "UnknownOpError",
+    "FrameError",
     "DISCONNECTED",
     "Request",
     "TokenStream",

@@ -1012,6 +1012,24 @@ class ServingEngine:
         where the shape tiles on this backend, else the gathered
         reference), 'pallas' (force; interpret mode off-TPU), 'gather'
         (force the reference). Paged mode only.
+      prefill_kernel: chunked-prefill attend implementation for the
+        mixed tick's T > 1 shapes (both cache layouts) — 'auto' (the
+        splash-style Pallas kernel of
+        :mod:`distkeras_tpu.ops.splash_prefill` where the shape tiles
+        on this backend: KV tiles beyond each row's diagonal skipped
+        outright, the compute-bound prefill-replica shape), 'splash'
+        (force; interpret mode off-TPU), 'gather' (force the dense
+        masked reference, which stays the bit-parity baseline).
+      role: advertised replica specialization for disaggregated
+        serving — 'mixed' (default), 'prefill' (a compute-optimized
+        replica the router sends long prompts to, exporting their KV
+        blocks afterwards via :meth:`export_blocks`), or 'decode' (a
+        memory-optimized replica that imports migrated blocks via
+        :meth:`import_blocks` and serves the decode steady state).
+        Purely declarative: surfaced in :meth:`stats` for the router's
+        pool classification; shape the replica itself with
+        ``tick_token_budget`` / ``prefill_chunk`` /
+        ``prefill_kernel``.
       draft: enable speculative decoding (chunked mode only). Either a
         small TRAINING-mode :class:`TransformerLM` (same vocab; pass
         its variables as ``draft_params``) that proposes ``spec_k``
@@ -1083,11 +1101,25 @@ class ServingEngine:
                  postmortem_dir: str = "/tmp",
                  mesh=None, tp_axis: str = "model",
                  paged_kernel: str = "auto",
+                 prefill_kernel: str = "auto",
                  draft=None, draft_params=None, spec_k: int = 4,
                  ngram_max: int = 3, device=None,
-                 pipeline: bool = False):
+                 pipeline: bool = False, role: str = "mixed"):
         if slots < 1:
             raise ValueError(f"slots must be >= 1; got {slots}")
+        if role not in ("mixed", "prefill", "decode"):
+            raise ValueError(
+                f"Unknown role '{role}'. Known: mixed (the default — "
+                f"serves everything), prefill (compute-optimized "
+                f"replica a router sends long prompts to), decode "
+                f"(memory-optimized replica that receives migrated KV "
+                f"blocks). The role is advertised in stats() and steers "
+                f"router pool selection only; engine behavior is shaped "
+                f"by the ordinary knobs (tick_token_budget, "
+                f"prefill_chunk, prefill_kernel)."
+            )
+        self.role = role
+        self.prefill_kernel = prefill_kernel
         if prefill_chunk is not None and prefill_chunk < 1:
             raise ValueError(
                 f"prefill_chunk must be >= 1 (or None for monolithic "
@@ -1256,6 +1288,7 @@ class ServingEngine:
             paged_kw = dict(
                 decode=True, paged=True, page_block_size=block_size,
                 num_pages=num_blocks, paged_kernel=paged_kernel,
+                prefill_kernel=prefill_kernel,
                 parent=None,
             )
             self._dm_paged = self.model.clone(
@@ -1311,10 +1344,12 @@ class ServingEngine:
             tp_kw = ({"tp_size": self.tp, "tp_axis": tp_axis}
                      if mesh is not None else {})
             self._dm_slot = self.model.clone(
-                decode=True, slot_cursor=True, parent=None, **tp_kw
+                decode=True, slot_cursor=True,
+                prefill_kernel=prefill_kernel, parent=None, **tp_kw
             )
-            self._dm_one = self.model.clone(decode=True, parent=None,
-                                            **tp_kw)
+            self._dm_one = self.model.clone(decode=True,
+                                            prefill_kernel=prefill_kernel,
+                                            parent=None, **tp_kw)
             dm_tpl = (self._dm_slot if mesh is None
                       else self.model.clone(decode=True,
                                             slot_cursor=True,
@@ -1411,6 +1446,15 @@ class ServingEngine:
         self.restores = 0
         self._tick_demoted = 0
         self._tick_restored = 0
+        # KV-block migration (disaggregated serving): control calls
+        # marshalled onto the engine loop thread (export/import touch
+        # the lock-free engine-thread-only pool/prefix/cache state),
+        # plus per-engine and per-tick transfer accounting
+        self._ctrl: deque = deque()
+        self.kv_blocks_exported = 0
+        self.kv_blocks_imported = 0
+        self._tick_exported = 0
+        self._tick_imported = 0
 
     def _init_mesh_ctx(self):
         """Shard the device-side engine state onto the mesh and build
@@ -1693,6 +1737,7 @@ class ServingEngine:
             raise
 
     def _step(self) -> bool:
+        self._drain_ctrl()
         if self.pipeline:
             return self._pipelined_step()
         n_prefills = self._admit()
@@ -2006,7 +2051,12 @@ class ServingEngine:
         prefill immediately; non-empty = RESTORING until the uploads
         land)."""
         bs = self.block_size
-        m = self.prefix.match(req.prompt) if self.prefix else None
+        # `is not None`, NOT truthiness: __len__ counts device nodes
+        # only, so an index whose entries are all host-resident (fully
+        # demoted tier, or a fresh KV import into the host pool) is
+        # falsy — the old check silently skipped its hits
+        m = (self.prefix.match(req.prompt) if self.prefix is not None
+             else None)
         shared = list(m.blocks) if m else []
         host_hits = list(m.host) if m else []
         total = self._blocks_for(req)
@@ -2256,6 +2306,168 @@ class ServingEngine:
             lens[s] = new_cached
         if lens is not None:
             self._seq_lens = lens
+
+    # -- KV-block migration (disaggregated serving) --------------------------
+
+    def _drain_ctrl(self):
+        """Service queued control calls (KV export/import from server
+        handler threads) at the top of each step: the pool, radix
+        index, and cache rebinding are engine-thread-only by design, so
+        cross-thread work is marshalled here instead of locked."""
+        while self._ctrl:
+            try:
+                fn, ev, box = self._ctrl.popleft()
+            except IndexError:  # pragma: no cover - single consumer
+                break
+            try:
+                box["val"] = fn()
+            except BaseException as e:
+                box["err"] = e
+            finally:
+                ev.set()
+
+    def call_in_loop(self, fn, timeout: float = 60.0):
+        """Run ``fn()`` on the engine loop thread between ticks and
+        return its result (exceptions propagate). The thread-safe entry
+        point for :meth:`export_blocks` / :meth:`import_blocks` from
+        TCP handler threads; requires the loop (``serve_forever``) — or
+        a test driving :meth:`step` — to be running."""
+        ev = threading.Event()
+        box: dict = {}
+        self._ctrl.append((fn, ev, box))
+        if not ev.wait(timeout):
+            raise TimeoutError(
+                f"engine loop did not service the control call within "
+                f"{timeout}s (is serve_forever running?)"
+            )
+        if "err" in box:
+            raise box["err"]
+        return box.get("val")
+
+    def export_blocks(self, prompt) -> dict:
+        """Serialize the cached KV blocks covering ``prompt``'s prefix
+        for migration to another replica (the ``export_kv`` wire op;
+        engine-thread-only — handler threads go through
+        :meth:`call_in_loop`). The radix match yields the device chain
+        plus any host-tier suffix; device blocks are gathered with the
+        tier's batched :func:`_gather_block_fn` (ALL gathers dispatch
+        before the first host copy blocks — one device round trip),
+        host chunks are served straight from the spill tier. Contents
+        are UNSHARDED whatever the mesh (the gather assembles the
+        global view), so a tp=4 prefill replica can feed a tp=1 decode
+        replica. Returns ``{"tokens": covered, "blocks": [[leaf
+        arrays...] per block]}`` — ``tokens`` is 0 when nothing is
+        cached (the caller's seeded-replay fallback prefills from
+        scratch; losing the race with eviction is a slow path, never an
+        error)."""
+        if not self.paged or self.prefix is None:
+            return {"tokens": 0, "blocks": []}
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        m = self.prefix.match(prompt)
+        gather = _gather_block_fn(self._blk_leaf_idx)
+        outs = [gather(self._cache, jnp.int32(b)) for b in m.blocks]
+        blocks = [[np.asarray(x) for x in out] for out in outs]
+        for h in m.host:
+            leaves = (self.host.peek(h) if self.host is not None
+                      else None)
+            if leaves is None:
+                break  # entry evicted under us: export the prefix we have
+            blocks.append([np.asarray(a) for a in leaves])
+        n = len(blocks)
+        self.kv_blocks_exported += n
+        self._tick_exported += n
+        return {"tokens": n * self.block_size, "blocks": blocks}
+
+    def import_blocks(self, prompt, blocks) -> dict:
+        """Install migrated KV blocks for ``prompt``'s prefix (the
+        ``import_kv`` wire op; engine-thread-only — handler threads go
+        through :meth:`call_in_loop`). With a host tier the contents
+        land in the spill pool and the chunks register as HOST-resident
+        radix nodes — the first hit admits RESTORING and swaps them in
+        through the ordinary pipelined-overlap restore path. Without
+        one they scatter straight into freshly allocated device blocks
+        (the tier's fixed-width batched :func:`_restore_blocks_fn`,
+        re-sharding onto any mesh) and register as ordinary cached
+        prefix blocks. Either way the next admission of this prompt
+        hits the prefix cache and prefills only the tail — migrated
+        streams stay bit-identical to a local run. Chunks already
+        cached keep their resident copy; device import never evicts
+        live data (it imports at most what free + evictable blocks
+        allow). Returns ``{"imported": k, "tokens": k * block_size,
+        "mode": "host" | "device"}``."""
+        if not self.paged or self.prefix is None:
+            raise ValueError(
+                "KV import needs a paged engine with the prefix cache "
+                "(paged=True, prefix_cache=True)"
+            )
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        bs = self.block_size
+        n = min(len(blocks), int(prompt.size) // bs)
+        tpl = jax.tree.leaves(self._cache)
+        want = [tuple(tpl[li].shape[1:]) for li in self._blk_leaf_idx]
+        for bl in blocks[:n]:
+            if (len(bl) != len(want)
+                    or any(tuple(np.shape(a)) != w
+                           for a, w in zip(bl, want))):
+                raise ValueError(
+                    f"imported block leaves do not match this engine's "
+                    f"paged cache layout (want {len(want)} leaves of "
+                    f"shapes {want})"
+                )
+        if n == 0:
+            return {"imported": 0, "tokens": 0, "mode": "none"}
+        if self.host is not None:
+            handles: List[int] = []
+            for leaves in blocks[:n]:
+                h, lru_evicted = self.host.put(
+                    [np.asarray(a) for a in leaves])
+                for he in lru_evicted:
+                    for hh in self.prefix.drop_host(he):
+                        self.host.discard(hh)
+                if h is None:
+                    break  # tier full of pinned entries: partial import
+                handles.append(h)
+            reg = set(self.prefix.insert_host(
+                prompt[:len(handles) * bs], handles))
+            for h in handles:
+                if h not in reg:
+                    self.host.discard(h)  # chunk already cached
+            k, mode = len(handles), "host"
+        else:
+            avail = (self.pool.free_count()
+                     + self.prefix.evictable_count(self.pool.ref))
+            k = min(n, avail)
+            if k == 0:
+                return {"imported": 0, "tokens": 0, "mode": "device"}
+            fresh = self._alloc_blocks(k)
+            R = self.scheduler.restore_budget
+            restore_f = _restore_blocks_fn(self._blk_leaf_idx)
+            i = 0
+            while i < k:
+                take = min(R, k - i)
+                stacked = None
+                dsts = np.zeros((R,), np.int32)  # pad -> trash block 0
+                for j in range(take):
+                    leaves = [np.asarray(a) for a in blocks[i + j]]
+                    if stacked is None:
+                        stacked = [np.zeros((R,) + a.shape, a.dtype)
+                                   for a in leaves]
+                    for li, a in enumerate(leaves):
+                        stacked[li][j] = a
+                    dsts[j] = fresh[i + j]
+                self._cache = restore_f(self._cache, stacked,
+                                        jnp.asarray(dsts))
+                i += take
+            registered = set(self.prefix.insert(prompt[:k * bs], fresh))
+            dup = [b for b in fresh if b not in registered]
+            if dup:
+                # chunks another request cached first: the resident
+                # copy wins, the duplicate frees (concurrent-miss rule)
+                self.pool.free(dup)
+            mode = "device"
+        self.kv_blocks_imported += k
+        self._tick_imported += k
+        return {"imported": k, "tokens": k * bs, "mode": mode}
 
     def _mixed_tick(self):
         """One fused mixed prefill/decode tick, sync mode: plan and
@@ -3083,10 +3295,17 @@ class ServingEngine:
                     snap["demoted"] = self._tick_demoted
                     snap["restored"] = self._tick_restored
                     snap["host_blocks"] = self.host.count()
+                if self._tick_exported or self._tick_imported:
+                    # KV-block migration: blocks exported/imported by
+                    # control calls serviced since the previous tick
+                    snap["kv_exported"] = self._tick_exported
+                    snap["kv_imported"] = self._tick_imported
             self.flight.record(snap)
         self._flight_ns += time.perf_counter_ns() - t0
         self._tick_demoted = 0
         self._tick_restored = 0
+        self._tick_exported = 0
+        self._tick_imported = 0
 
     def stats(self) -> dict:
         """Counters + latency percentiles (TTFT and per-token, ms) for
@@ -3094,6 +3313,11 @@ class ServingEngine:
         series) is ``self.registry.collect()`` — served by the TCP
         ``metrics`` op and the HTTP endpoint."""
         out = {
+            # replica specialization (disaggregated serving): the
+            # router classifies replicas into prefill/decode pools from
+            # this advertised role; "mixed" serves everything
+            "role": self.role,
+            "prefill_kernel": self.prefill_kernel,
             "ticks": self.ticks,
             "requests_completed": self.requests_completed,
             "tokens_generated": self.tokens_generated,
@@ -3183,6 +3407,11 @@ class ServingEngine:
                     round(self.prefix_hit_tokens / self.prompt_tokens, 4)
                     if self.prompt_tokens else 0.0
                 ),
+                # KV-block migration (disaggregated serving): blocks
+                # this engine shipped out / installed via the
+                # export_kv / import_kv ops
+                "kv_blocks_exported": self.kv_blocks_exported,
+                "kv_blocks_imported": self.kv_blocks_imported,
             })
             if self.host is not None:
                 # tiered KV cache: the router's spill gate reads

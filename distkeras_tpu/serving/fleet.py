@@ -54,6 +54,7 @@ _SUM_KEYS = (
     "active_slots", "prompt_tokens", "prefix_hit_tokens",
     "blocks_in_use", "blocks_free", "blocks_reclaimable",
     "draft_tokens", "accepted_tokens", "decode_stalls",
+    "kv_blocks_exported", "kv_blocks_imported",
 )
 
 
@@ -102,6 +103,17 @@ class Replica:
             self.state = DOWN
         if client is not None:
             client.close()
+
+    @property
+    def role(self) -> str:
+        """Advertised replica specialization, from the last polled
+        stats: ``prefill`` / ``decode`` / ``mixed`` (the default for
+        replicas that predate roles). The router's disaggregation pool
+        split keys on this."""
+        # analysis: unguarded-ok (monitor read of a probe-thread dict
+        # rebind; a stale role only delays a pool reclassification one
+        # poll, exactly like every other last_stats consumer)
+        return str(self.last_stats.get("role", "mixed"))
 
     def snapshot(self) -> Dict:
         """Plain-data view for the aggregated stats op. ``state`` and
@@ -192,7 +204,8 @@ class ReplicaManager:
                  backoff_base: float = 0.2,
                  backoff_max: float = 5.0,
                  registry: Optional[telemetry.MetricRegistry] = None,
-                 on_down: Optional[Callable[[Replica], None]] = None):
+                 on_down: Optional[Callable[[Replica], None]] = None,
+                 on_drain: Optional[Callable[[Replica], None]] = None):
         if not replicas:
             raise ValueError("ReplicaManager needs at least one replica")
         names = [r.name for r in replicas]
@@ -206,6 +219,12 @@ class ReplicaManager:
         self.backoff_max = backoff_max
         self.registry = registry or telemetry.get_registry()
         self.on_down = on_down
+        # fired once per transition INTO draining (probe-detected or
+        # noted via note_drain): the router forgets the replica's
+        # affinity placements so traffic stops steering at a replica
+        # that refuses it — previously only death forgot them, and a
+        # drained replica kept attracting its whole prefix keyspace
+        self.on_drain = on_drain
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._m_up = self.registry.gauge(
@@ -287,7 +306,10 @@ class ReplicaManager:
             r.failures = 0
             r.backoff_s = 0.0
             r.last_stats = dict(stats)
+            was = r.state
             r.state = DRAINING if stats.get("draining") else HEALTHY
+        if r.state == DRAINING and was != DRAINING:
+            self.note_drain(r)
         self._m_up.labels(replica=r.name).set(1)
         self._m_depth.labels(replica=r.name).set(
             stats.get("queue_depth", 0))
@@ -303,6 +325,18 @@ class ReplicaManager:
         waiting for the next probe round."""
         self._down(r)
         self._m_up.labels(replica=r.name).set(0)
+
+    def note_drain(self, r: Replica):
+        """A replica entered draining (probe-detected, or the router
+        forwarded an admin drain and flipped the state itself): fire
+        the ``on_drain`` hook so placement state stops steering
+        traffic at it. Safe to call repeatedly; the probe path already
+        deduplicates transitions."""
+        if self.on_drain is not None:
+            try:
+                self.on_drain(r)
+            except Exception:
+                pass  # a drain-hook bug must not kill the probe loop
 
     def _down(self, r: Replica):
         was_down = r.state == DOWN
@@ -325,12 +359,17 @@ class ReplicaManager:
         raise KeyError(f"no replica named {name!r}; have "
                        f"{[r.name for r in self.replicas]}")
 
-    def routable(self) -> List[Replica]:
+    def routable(self, roles=None) -> List[Replica]:
         """Replicas eligible for NEW requests: healthy or suspect (a
         single missed probe sheds no traffic), never down or
-        draining."""
-        return [r for r in self.replicas
-                if r.state in (HEALTHY, SUSPECT) and r.client is not None]
+        draining. ``roles`` optionally restricts to advertised replica
+        roles (the disaggregation pool split: ``("prefill",)`` for the
+        prefill pool, ``("decode", "mixed")`` for the decode side)."""
+        out = [r for r in self.replicas
+               if r.state in (HEALTHY, SUSPECT) and r.client is not None]
+        if roles is not None:
+            out = [r for r in out if r.role in roles]
+        return out
 
     # -- aggregation --------------------------------------------------------
 

@@ -194,6 +194,18 @@ class HostBlockPool:
         self._m_bytes.set(b)
         return e[0]
 
+    def peek(self, handle: int) -> Optional[List[np.ndarray]]:
+        """Read an entry's leaf arrays WITHOUT removing it (KV-block
+        export serves host-resident chunks straight from the tier — no
+        device gather, no restore accounting). LRU recency is bumped;
+        ``None`` when the entry is gone."""
+        with self._lock:
+            e = self._entries.get(handle)
+            if e is None:
+                return None
+            self._entries.move_to_end(handle)
+            return list(e[0])
+
     def discard(self, handle: int) -> None:
         """Drop an entry without counting a restore (the radix-subtree
         cleanup after an LRU eviction unlinked its ancestors).

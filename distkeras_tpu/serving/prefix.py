@@ -219,6 +219,43 @@ class RadixPrefixIndex:
             node = child
         return registered
 
+    def insert_host(self, tokens, handles) -> List[int]:
+        """Register a migrated prompt's chunks as HOST-resident nodes
+        (KV-block import on an engine with a host tier: the imported
+        contents sit in the :class:`HostBlockPool` and swap in through
+        the ordinary RESTORING machinery on the first hit). Chunk ``i``
+        of ``tokens`` is backed by host entry ``handles[i]``; chunks
+        already present — device or host — keep their existing node
+        (the resident copy is at least as good as the imported one).
+        Returns the handles actually registered; the caller discards
+        the rest from the host pool. The residency suffix invariant
+        holds by construction: a freshly created node is always a leaf,
+        and host nodes may sit below anything."""
+        toks = tuple(int(t) for t in tokens)
+        bs = self.block_size
+        n_full = min(len(toks) // bs, len(handles))
+        now = self._tick()
+        node = self._root
+        registered: List[int] = []
+        for i in range(n_full):
+            key = toks[i * bs:(i + 1) * bs]
+            child = node.children.get(key)
+            if child is None:
+                h = int(handles[i])
+                if h in self._by_host:
+                    raise ValueError(
+                        f"host handle {h} already registered"
+                    )
+                child = _Node(key, None, node)
+                child.resident = "host"
+                child.handle = h
+                node.children[key] = child
+                self._by_host[h] = child
+                registered.append(h)
+            child.last_access = now
+            node = child
+        return registered
+
     # -- residency transitions ----------------------------------------------
 
     def demote(self, block: int, handle: int) -> None:

@@ -277,6 +277,20 @@ class Router:
       spill_queue_depth / spill_min_free_blocks: saturation thresholds
         on the polled replica stats.
       max_index_nodes: router-side radix size bound (LRU beyond it).
+      disagg_prompt_tokens: enable prefill/decode disaggregation —
+        prompts of at least this many tokens route through the
+        prefill pool when the fleet advertises one (replicas whose
+        ``stats()`` report ``role="prefill"`` / ``"decode"``): the
+        prompt runs on a prefill replica, its KV blocks migrate to a
+        decode replica over the ``export_kv``/``import_kv`` ops, and
+        the request decodes there off a prefix-cache hit. Any failure
+        along the way (empty export after losing the race with
+        eviction, an unavailable pool, a refused import) falls back to
+        the ordinary route — seeded decoding recomputes the identical
+        stream, so migration is an optimization, never a correctness
+        dependency. ``None`` (default) disables. With roles present,
+        prefill-pool replicas are excluded from ordinary routing
+        whenever a non-prefill replica is routable.
       max_replays: failover replays attempted per request before its
         stream is failed with reason ``"error"``.
       poll_interval / probe_timeout / down_after / backoff_base /
@@ -299,7 +313,9 @@ class Router:
                  block_size: int = 16, min_affinity_blocks: int = 1,
                  spill_queue_depth: int = 8,
                  spill_min_free_blocks: int = 0,
-                 max_index_nodes: int = 4096, max_replays: int = 3,
+                 max_index_nodes: int = 4096,
+                 disagg_prompt_tokens: Optional[int] = None,
+                 max_replays: int = 3,
                  poll_interval: float = 0.25, probe_timeout: float = 5.0,
                  down_after: int = 2, backoff_base: float = 0.2,
                  backoff_max: float = 5.0,
@@ -330,11 +346,13 @@ class Router:
             probe_timeout=probe_timeout, down_after=down_after,
             backoff_base=backoff_base, backoff_max=backoff_max,
             registry=self.registry, on_down=self._on_replica_down,
+            on_drain=self._on_replica_drain,
         )
         self.index = PrefixAffinityIndex(block_size=block_size,
                                          max_nodes=max_index_nodes)
         self.ring = _HashRing([r.name for r in built])
         self.min_affinity_blocks = max(int(min_affinity_blocks), 1)
+        self.disagg_prompt_tokens = disagg_prompt_tokens
         self.spill_queue_depth = spill_queue_depth
         self.spill_min_free_blocks = spill_min_free_blocks
         self.max_replays = max_replays
@@ -385,6 +403,26 @@ class Router:
         self._m_inflight = self.registry.gauge(
             "router_inflight_requests",
             "requests currently proxied through the router",
+        )
+        # prefill/decode disaggregation: migration attempts by outcome
+        # (ok / export_empty when the prefill replica lost the race
+        # with its own eviction / import_empty / prefill_failed), the
+        # end-to-end migration latency, and the KV payload size
+        self._m_migrations = self.registry.counter(
+            "serving_kv_migrations_total",
+            "prefill->decode KV-block migrations attempted, by outcome",
+            labelnames=("outcome",),
+        )
+        self._m_migration_ms = self.registry.histogram(
+            "serving_kv_migration_ms",
+            "end-to-end KV migration latency: prefill submit through "
+            "import ack (ms)",
+        )
+        self._m_migrated_bytes = self.registry.histogram(
+            "serving_kv_migrated_bytes",
+            "KV payload bytes per successful block migration",
+            buckets=(1 << 10, 1 << 14, 1 << 17, 1 << 20, 1 << 23,
+                     1 << 26, 1 << 30),
         )
         # fleet tracing: completed chains archived per request, and the
         # router's own critical-path phase (routing overhead) in the
@@ -443,6 +481,18 @@ class Router:
         self.tracer.record(None, "router.replica_down", time.monotonic(),
                            0.0, replica=replica.name)
 
+    def _on_replica_drain(self, replica: Replica):
+        """A replica entered draining (probe-detected or admin drain):
+        forget its affinity placements so same-prefix traffic re-places
+        on replicas that will actually accept it. Before this hook only
+        death forgot placements — a *drained* replica kept owning its
+        prefix keyspace, and every affine request aimed at it just to
+        bounce off the draining refusal."""
+        with self._route_lock:
+            self.index.forget(replica.name)
+        self.tracer.record(None, "router.replica_drain",
+                           time.monotonic(), 0.0, replica=replica.name)
+
     # -- routing ------------------------------------------------------------
 
     def _saturated(self, r: Replica) -> bool:
@@ -472,6 +522,12 @@ class Router:
         routable."""
         cands = [r for r in self.manager.routable()
                  if r.name not in exclude]
+        # replicas advertising role="prefill" serve the prefill pool
+        # (long prompts via migration), not ordinary traffic — unless
+        # they are all that is left, when serving beats refusing
+        nonpre = [r for r in cands if r.role != "prefill"]
+        if nonpre:
+            cands = nonpre
         if not cands:
             raise ServingConnectionError(
                 f"no routable replica (fleet of "
@@ -506,13 +562,123 @@ class Router:
                 return target, "spill"
         return preferred, decision
 
+    def _try_disagg(self, entry: _Entry, exclude: Set[str]) -> bool:
+        """Prefill/decode disaggregation for one submit attempt: run a
+        long prompt through the prefill pool, migrate its KV blocks to
+        a decode replica (``export_kv`` → ``import_kv``), and submit
+        the real request there — the decode replica's prefix cache hits
+        the migrated span, so it prefills only the tail and its decode
+        streams never feel the prompt. Returns True when the request
+        was submitted this way; False falls through to the ordinary
+        route (the seeded-replay fallback: a fresh prefill recomputes
+        the identical stream, so losing the migration race with
+        eviction — or an empty pool — costs latency, never
+        correctness)."""
+        if self.disagg_prompt_tokens is None:
+            return False
+        prompt = entry.params["prompt"]
+        if len(prompt) < self.disagg_prompt_tokens:
+            return False
+        pre = [r for r in self.manager.routable(roles=("prefill",))
+               if r.name not in exclude and r.client is not None]
+        dec = [r for r in self.manager.routable(roles=("decode", "mixed"))
+               if r.name not in exclude and r.client is not None]
+        if not pre or not dec:
+            return False
+        with self._route_lock:
+            owner, hit = self.index.lookup(prompt)
+        if (owner is not None and any(r.name == owner for r in dec)
+                and hit >= len(prompt) - 2 * self.index.block_size):
+            # a decode replica already holds (nearly) this whole
+            # prefix: the ordinary affine route IS the cache hit, and
+            # a migration would only re-ship resident blocks
+            return False
+        src = min(pre, key=lambda r: (
+            r.last_stats.get("active_slots", 0),
+            r.last_stats.get("queue_depth", 0),
+        ))
+        relief = [r for r in dec if not self._saturated(r)] or dec
+        dst = min(relief, key=lambda r: (
+            r.last_stats.get("queue_depth", 0),
+            r.last_stats.get("active_slots", 0),
+        ))
+        t0 = time.perf_counter()
+        outcome = "prefill_failed"
+        nbytes = 0
+        ok = False
+        try:
+            sclient, dclient = src.client, dst.client
+            if sclient is None or dclient is None:
+                return False
+            # a 1-token run forces the prompt through the prefill
+            # replica's compute-optimized path and registers its
+            # blocks in the radix index at finish; the token itself is
+            # discarded (greedy, so no sampling state is consumed)
+            rid = sclient.generate(prompt, 1, temperature=0.0,
+                                   seed=int(entry.params.get("seed", 0)),
+                                   trace=entry.trace_id,
+                                   parent_span="router.migrate")
+            for kind, _val in sclient.frames(rid):
+                if kind == "end":
+                    break
+            exp = sclient.export_kv(prompt)
+            if exp["tokens"] <= 0 or not exp["blocks"]:
+                # lost the race with the prefill replica's own
+                # eviction: nothing to ship — seeded-replay fallback
+                outcome = "export_empty"
+                return False
+            outcome = "import_failed"
+            imp = dclient.import_kv(prompt, exp["blocks"])
+            if imp["imported"] <= 0:
+                return False
+            nbytes = sum(int(getattr(a, "nbytes", 0) or 0)
+                         for blk in exp["blocks"] for a in blk)
+            outcome = "submit_failed"
+            entry.backend_rid = dclient.generate(
+                prompt, entry.params["max_new_tokens"],
+                trace=entry.trace_id, parent_span="router.route",
+                **{k: v for k, v in entry.params.items()
+                   if k not in ("prompt", "max_new_tokens")},
+            )
+            outcome, ok = "ok", True
+        except (OverloadedError, DrainingError, ServingConnectionError,
+                TimeoutError):
+            return False
+        finally:
+            ms = (time.perf_counter() - t0) * 1e3
+            self._m_migrations.labels(outcome=outcome).inc()
+            self._m_migration_ms.observe(ms)
+            if nbytes:
+                self._m_migrated_bytes.observe(nbytes)
+            self.tracer.record(
+                entry.trace_id, "router.migrate", time.monotonic(),
+                0.0, outcome=outcome, prefill_replica=src.name,
+                decode_replica=dst.name, bytes=nbytes,
+                migration_ms=round(ms, 3),
+            )
+        entry.replica, entry.client = dst, dclient
+        entry.n_backend = 0
+        if self.policy == "affine":
+            with self._route_lock:
+                self.index.place(prompt, dst.name)
+        self._m_routed.labels(replica=dst.name, decision="disagg").inc()
+        self.tracer.record(entry.trace_id, "router.route",
+                           time.monotonic(), 0.0, replica=dst.name,
+                           decision="disagg", replay=entry.replays)
+        return ok
+
     def _submit_routed(self, entry: _Entry, exclude: Set[str]):
-        """Route-and-submit with retries across the fleet. Typed
-        backend refusals (overloaded / draining / dead connection)
-        move to the next candidate; request-level errors (bad params)
-        propagate to the caller untouched. Raises OverloadedError when
-        every routable replica refused for load — the router's
-        admission-control boundary."""
+        """Route-and-submit with retries across the fleet. Long
+        prompts try the disaggregated prefill→decode migration path
+        first (:meth:`_try_disagg`); every failure there falls through
+        to the ordinary route below. Typed backend refusals
+        (overloaded / draining / dead connection) move to the next
+        candidate; request-level errors (bad params) propagate to the
+        caller untouched. Raises OverloadedError when every routable
+        replica refused for load — the router's admission-control
+        boundary."""
+        if self._try_disagg(entry, exclude):
+            return
         overloaded: Optional[OverloadedError] = None
         last_exc: Optional[Exception] = None
         for _ in range(len(self.manager.replicas)):
@@ -772,6 +938,22 @@ class Router:
                             "error": "flight recorder lives per replica"
                                      " — scrape replicas directly",
                         })
+                    elif op == "export_kv":
+                        self._send(conn, lock, {
+                            "ok": 0,
+                            "error": "kv migration is orchestrated by "
+                                     "the router (disagg_prompt_tokens)"
+                                     " — point export_kv at a replica "
+                                     "directly",
+                        })
+                    elif op == "import_kv":
+                        self._send(conn, lock, {
+                            "ok": 0,
+                            "error": "kv migration is orchestrated by "
+                                     "the router (disagg_prompt_tokens)"
+                                     " — point import_kv at a replica "
+                                     "directly",
+                        })
                     else:
                         # typed terminal arm, mirroring LMServer: the
                         # proxied op set is closed and the wire-contract
@@ -868,6 +1050,10 @@ class Router:
             return
         reply = client.drain()
         replica.state = DRAINING  # stop routing now, not at next poll
+        # forget its affinity placements now too — the probe loop only
+        # fires on_drain for transitions IT observes, and this state
+        # was just set under its feet
+        self.manager.note_drain(replica)
         self._send(conn, lock, {"ok": 1, "draining": 1,
                                 "replica": replica.name, **reply})
 
@@ -917,6 +1103,16 @@ class Router:
                 "router_requests_failed_total").value,
             "overload_rejections": self.registry.counter(
                 "router_overload_rejections_total").value,
+            # prefill/decode disaggregation: None = disabled; the
+            # outcome-labeled counter total and the migration latency
+            # percentiles come from the router-side registry series
+            "disagg_prompt_tokens": self.disagg_prompt_tokens,
+            "kv_migrations": self._counter_total(
+                "serving_kv_migrations_total"),
+            "kv_migration_ms": {
+                "p50": self._m_migration_ms.percentile(50),
+                "p99": self._m_migration_ms.percentile(99),
+            },
             "critical_path_ms": {
                 "router": {
                     "p50": self._m_critical.percentile(
@@ -970,4 +1166,5 @@ class Router:
             )
         reply = client.drain()
         replica.state = DRAINING
+        self.manager.note_drain(replica)  # placement forget, immediate
         return reply

@@ -21,6 +21,14 @@ Protocol (all frames are msgpack dicts):
     {"op": "flight", "last"?: n}              # flight-recorder ticks
     {"op": "alerts"}                          # SLO monitor state
     {"op": "drain"}                           # close admissions (graceful)
+    {"op": "export_kv", "prompt": [ids]}      # gather the cached KV
+                                              # blocks covering the
+                                              # prompt's prefix, for
+                                              # migration to a peer
+    {"op": "import_kv", "prompt": [ids], "blocks": [[leaf arrays]]}
+                                              # install migrated KV
+                                              # blocks into this
+                                              # replica's prefix cache
 
   server → client
     {"ok": 1, "id": rid, "trace": tid}        # generate accepted
@@ -47,6 +55,11 @@ Protocol (all frames are msgpack dicts):
     {"ok": 1, "flight": {"meta":..,"ticks":[..]}}   # FlightRecorder ring
     {"ok": 1, "alerts": [...]}                # SloMonitor.alerts()
     {"ok": 1, "draining": 1, "active": a, "queued": q}   # drain accepted
+    {"ok": 1, "tokens": t, "blocks": [...]}   # export_kv reply (tokens
+                                              # 0 = nothing cached —
+                                              # the caller falls back
+                                              # to seeded replay)
+    {"ok": 1, "imported": k, "tokens": t, "mode": m}   # import_kv reply
 
 The ``trace`` id in the generate ack is the request's telemetry trace id
 (allocated at admission, OR propagated verbatim when the submit carried
@@ -368,6 +381,32 @@ class LMServer:
                                   if self.slo is not None else [])
                         self._send(conn, lock,
                                    {"ok": 1, "alerts": alerts})
+                    elif op == "export_kv":
+                        # KV-block migration, the prefill-replica half:
+                        # gather the cached blocks covering this
+                        # prompt's prefix. Marshalled onto the engine
+                        # loop thread — pool/prefix/cache state is
+                        # engine-thread-only by design
+                        out = self.engine.call_in_loop(
+                            lambda m=msg: self.engine.export_blocks(
+                                [int(t) for t in m["prompt"]]))
+                        self._send(conn, lock, {
+                            "ok": 1, "tokens": out["tokens"],
+                            "blocks": out["blocks"],
+                        })
+                    elif op == "import_kv":
+                        # the decode-replica half: install migrated
+                        # blocks so the next admission of this prompt
+                        # hits the prefix cache
+                        out = self.engine.call_in_loop(
+                            lambda m=msg: self.engine.import_blocks(
+                                [int(t) for t in m["prompt"]],
+                                m["blocks"]))
+                        self._send(conn, lock, {
+                            "ok": 1, "imported": out["imported"],
+                            "tokens": out["tokens"],
+                            "mode": out["mode"],
+                        })
                     elif op == "drain":
                         # graceful drain: admissions close now; queued +
                         # in-flight streams finish under the normal loop
@@ -420,7 +459,8 @@ class ServingClient:
     queues, so many requests can be in flight on one connection."""
 
     def __init__(self, host: str, port: int, timeout: Optional[float] = 60.0,
-                 request_timeout: float = 60.0):
+                 request_timeout: float = 60.0,
+                 max_frame_bytes: int = MAX_SERVE_FRAME_BYTES):
         """``timeout`` bounds raw socket operations (None = no socket
         deadline — long-lived backend connections that may sit idle,
         e.g. a router's, rely on request-level timeouts instead);
@@ -429,8 +469,18 @@ class ServingClient:
         inherited by every call unless overridden per call. Expiries
         raise :class:`TimeoutError` naming the operation/request; a
         refused or dead connection raises
-        :class:`ServingConnectionError` naming ``host:port``."""
+        :class:`ServingConnectionError` naming ``host:port``.
+        ``max_frame_bytes`` bounds each accepted reply frame: a frame
+        whose header announces more raises a typed
+        :class:`~distkeras_tpu.networking.FrameError` naming the limit
+        instead of attempting the allocation (as does a frame truncated
+        by a mid-payload close). The default (16 MiB) clears ordinary
+        token/stats traffic with room to spare; size it above the
+        largest expected KV block batch when :meth:`export_kv` payloads
+        ride this connection — roughly ``blocks_per_prompt x
+        block_nbytes`` for the served model."""
         self.host, self.port = host, int(port)
+        self.max_frame_bytes = max_frame_bytes
         try:
             self._sock = connect(host, port)
         except OSError as e:
@@ -483,7 +533,8 @@ class ServingClient:
         reason = "closed by client"
         try:
             while True:
-                msg = recv_msg(self._sock)
+                msg = recv_msg(self._sock,
+                               max_bytes=self.max_frame_bytes)
                 if msg is None:
                     reason = "server closed the connection"
                     break
@@ -686,6 +737,35 @@ class ServingClient:
         """SLO alert state per rule (firing first); empty when the
         server has no monitor attached."""
         return list(self._call({"op": "alerts"})["alerts"])
+
+    def export_kv(self, prompt) -> dict:
+        """Gather the server's cached KV blocks covering ``prompt``'s
+        prefix for migration to another replica (the disaggregated
+        serving data plane; the router drives this against a
+        prefill-pool replica after the prompt ran there). Returns
+        ``{"tokens": covered_prefix_tokens, "blocks": [[leaf
+        arrays...] per block]}`` — ``tokens`` 0 means nothing is
+        cached (evicted since the prompt ran: fall back to a plain
+        submit, seeded decoding recomputes the identical stream)."""
+        reply = self._call({"op": "export_kv",
+                            "prompt": [int(t) for t in prompt]})
+        return {"tokens": int(reply["tokens"]),
+                "blocks": list(reply["blocks"])}
+
+    def import_kv(self, prompt, blocks) -> dict:
+        """Install migrated KV blocks on the server (the decode-pool
+        half of a migration): ``blocks`` is the ``blocks`` list an
+        :meth:`export_kv` against the source replica returned, covering
+        ``prompt``'s leading chunks. The server registers them in its
+        radix prefix cache, so the next submit of this prompt prefills
+        only the tail. Returns ``{"imported": k, "tokens": k *
+        block_size, "mode": "host" | "device"}``."""
+        reply = self._call({"op": "import_kv",
+                            "prompt": [int(t) for t in prompt],
+                            "blocks": list(blocks)})
+        return {"imported": int(reply["imported"]),
+                "tokens": int(reply["tokens"]),
+                "mode": str(reply["mode"])}
 
     def drain(self, replica: Optional[str] = None) -> dict:
         """Gracefully drain the server: admissions close immediately

@@ -279,6 +279,11 @@ def report_flight(path: str, last: Optional[int] = None,
         if "demoted" in r and (r.get("demoted") or r.get("restored")):
             # tiered KV cache: blocks swapped out/in this tick
             extra += f"  tier=-{r['demoted']}/+{r.get('restored', 0)}"
+        if r.get("kv_exported") or r.get("kv_imported"):
+            # disaggregated serving: KV blocks shipped out / installed
+            # by migration control calls since the previous tick
+            extra += (f"  kv={r.get('kv_exported', 0)}out"
+                      f"/{r.get('kv_imported', 0)}in")
         if "draft_tokens" in r:
             # speculative tick: accepted/proposed draft tokens
             extra += (f"  spec={r.get('accepted_tokens')}"
@@ -336,6 +341,15 @@ def report_flight(path: str, last: Optional[int] = None,
         out.write(
             f"host tier: {demoted} blocks demoted, {restored} "
             f"restored, {host_now} resident at last tick\n"
+        )
+    if any("kv_exported" in r or "kv_imported" in r for r in ticks):
+        # disaggregated serving: migration traffic through this
+        # replica across the retained window
+        exported = sum(int(r.get("kv_exported", 0)) for r in ticks)
+        imported = sum(int(r.get("kv_imported", 0)) for r in ticks)
+        out.write(
+            f"kv migration: {exported} blocks exported, "
+            f"{imported} imported\n"
         )
     worst = sorted(ticks, key=lambda r: float(r.get("tick_ms", 0.0)),
                    reverse=True)[:slow]

@@ -135,7 +135,8 @@ def test_protocol_extraction_matches_dispatch():
     proto = extract_protocol(iter_source_files([SERVER, ROUTER]))
     ops = set(proto.server.arms)
     assert ops == {"generate", "stats", "metrics", "trace_dump",
-                   "chrome_trace", "flight", "alerts", "drain"}
+                   "chrome_trace", "flight", "alerts", "drain",
+                   "export_kv", "import_kv"}
     assert set(proto.router.arms) == ops
     assert set(proto.client.ops) == ops
     assert proto.server.has_unknown_arm and proto.router.has_unknown_arm

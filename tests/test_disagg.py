@@ -1,0 +1,507 @@
+"""Disaggregated serving: KV-block migration between replicas, roles,
+the router's prefill/decode orchestration, and the framing hardening
+that keeps KV payloads safe on the wire.
+
+Parity bar everywhere: a migrated stream must be bit-identical to a
+solo ``generate()`` of the same request — migration is an optimization
+riding the prefix-cache parity invariant, and every failure (losing
+the race with eviction, an empty pool, a refused import) must fall
+back to plain seeded recompute with zero lost streams.
+"""
+
+import socket
+import struct
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distkeras_tpu import telemetry
+from distkeras_tpu.models import get_model
+from distkeras_tpu.models.transformer import generate
+from distkeras_tpu.networking import FrameError, recv_msg, send_msg
+from distkeras_tpu.serving import (
+    LMServer,
+    Router,
+    ServingClient,
+    ServingEngine,
+)
+
+V, D, H, L = 64, 64, 4, 2
+BS = 8  # block size
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    model = get_model(
+        "transformer_lm", vocab_size=V, d_model=D, num_heads=H,
+        num_layers=L, max_len=256, dtype=jnp.float32, attention="dense",
+    )
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 4), jnp.int32))
+    return model, params
+
+
+def _engine(model, params, *, role="mixed", chunk=16, num_blocks=128,
+            host_blocks=None, mesh=None, slots=2):
+    kw = {}
+    if mesh is not None:
+        kw["mesh"] = mesh
+    return ServingEngine(
+        model, params, slots=slots, paged=True, block_size=BS,
+        num_blocks=num_blocks, prefill_chunk=chunk, role=role,
+        host_blocks=host_blocks,
+        registry=telemetry.MetricRegistry(), tracer=telemetry.Tracer(),
+        **kw,
+    )
+
+
+def _want(model, params, prompt, n):
+    return np.asarray(
+        generate(model, params, jnp.asarray(prompt)[None], n)
+    )[0, len(prompt):].tolist()
+
+
+def _migrate(model, params, src, dst, prompt):
+    src.submit(prompt, max_new_tokens=1)
+    src.drain()
+    exp = src.export_blocks(prompt)
+    assert exp["tokens"] > 0
+    return exp, dst.import_blocks(prompt, exp["blocks"])
+
+
+# -- engine-level migration parity -------------------------------------------
+
+
+@pytest.mark.parametrize("chunk", [16, None])
+@pytest.mark.parametrize("host_blocks", [None, 32])
+def test_migration_parity(model_and_params, chunk, host_blocks):
+    """Export on one replica, import on another (device-direct and
+    host-tier RESTORING paths), across chunked and monolithic decode
+    replicas: migrated streams bit-identical to solo generate, and the
+    migrated span actually served from cache."""
+    if chunk is None and host_blocks is not None:
+        pytest.skip("host tier requires chunked prefill")
+    model, params = model_and_params
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(0, V, size=48).astype(np.int32)
+    src = _engine(model, params, role="prefill")
+    dst = _engine(model, params, role="decode", chunk=chunk,
+                  host_blocks=host_blocks)
+    exp, imp = _migrate(model, params, src, dst, prompt)
+    assert imp["imported"] == len(exp["blocks"])
+    assert imp["mode"] == ("host" if host_blocks else "device")
+    req = dst.submit(prompt, max_new_tokens=8, temperature=0.6, seed=3)
+    dst.drain()
+    want = np.asarray(generate(
+        model, params, jnp.asarray(prompt)[None], 8,
+        temperature=0.6, seed=3,
+    ))[0, 48:].tolist()
+    assert req.stream.tokens(timeout=120) == want
+    assert dst.prefix_hit_tokens == imp["tokens"] > 0
+    if host_blocks:
+        assert dst.restores == imp["imported"]
+    assert src.stats()["kv_blocks_exported"] == len(exp["blocks"])
+    assert dst.stats()["kv_blocks_imported"] == imp["imported"]
+
+
+@pytest.mark.slow
+def test_migration_parity_tp4(model_and_params):
+    """A tp=4 prefill replica feeds a tp=1 decode replica: exported
+    blocks are unsharded (the gather assembles the global view), so
+    migration crosses mesh shapes. Runs on the multichip CI job's
+    forced 4-device host."""
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 devices")
+    from distkeras_tpu.parallel.mesh import make_mesh
+
+    model, params = model_and_params
+    rng = np.random.default_rng(1)
+    prompt = rng.integers(0, V, size=40).astype(np.int32)
+    src = _engine(model, params, role="prefill",
+                  mesh=make_mesh({"model": 4}))
+    dst = _engine(model, params, role="decode")
+    exp, imp = _migrate(model, params, src, dst, prompt)
+    req = dst.submit(prompt, max_new_tokens=6)
+    dst.drain()
+    assert req.stream.tokens(timeout=120) == _want(model, params,
+                                                   prompt, 6)
+    assert dst.prefix_hit_tokens == imp["tokens"] > 0
+
+
+def test_export_loses_race_with_eviction(model_and_params):
+    """The fallback precondition: a prompt whose cached blocks were
+    evicted (pool sized to roughly one prompt; later admissions
+    reclaim them) exports a shrinking prefix and finally nothing — and
+    the recompute path still yields the identical stream."""
+    model, params = model_and_params
+    rng = np.random.default_rng(2)
+    a = rng.integers(0, V, size=48).astype(np.int32)
+    # one prompt's worst case (6 prompt blocks + 1 decode) + slack,
+    # but nowhere near two cached prompts
+    src = _engine(model, params, role="prefill", num_blocks=10)
+    src.submit(a, max_new_tokens=1)
+    src.drain()
+    full = src.export_blocks(a)["tokens"]
+    assert full == 40
+    for seed in (20, 21):  # evict a's chain block by block
+        b = rng.integers(0, V, size=48).astype(np.int32)
+        src.submit(b, max_new_tokens=1)
+        src.drain()
+    exp = src.export_blocks(a)  # a's blocks were reclaimed
+    assert exp["tokens"] == 0 and exp["blocks"] == []
+    # seeded recompute on a fresh replica: the stream migration would
+    # have produced, bit-identical
+    dst = _engine(model, params, role="decode")
+    req = dst.submit(a, max_new_tokens=6)
+    dst.drain()
+    assert req.stream.tokens(timeout=120) == _want(model, params, a, 6)
+
+
+def test_slot_engine_has_no_blocks_to_migrate(model_and_params):
+    """A slot-layout engine exports empty (nothing block-shaped to
+    ship) and refuses imports with a typed error — the router's
+    fallback handles both."""
+    model, params = model_and_params
+    eng = ServingEngine(
+        model, params, slots=2, prefill_chunk=16,
+        registry=telemetry.MetricRegistry(), tracer=telemetry.Tracer(),
+    )
+    assert eng.export_blocks([1, 2, 3]) == {"tokens": 0, "blocks": []}
+    with pytest.raises(ValueError, match="paged"):
+        eng.import_blocks([1, 2, 3], [[np.zeros((BS, 2, 16))]])
+
+
+def test_import_rejects_mismatched_layout(model_and_params):
+    model, params = model_and_params
+    dst = _engine(model, params)
+    with pytest.raises(ValueError, match="cache layout"):
+        dst.import_blocks(
+            np.arange(16, dtype=np.int32),
+            [[np.zeros((BS, 1, 1), np.float32)]],
+        )
+
+
+def test_import_dedups_resident_chunks(model_and_params):
+    """Importing a prompt the replica already caches keeps the
+    resident copy and frees the duplicates (the concurrent-miss
+    rule) — block accounting stays leak-free."""
+    model, params = model_and_params
+    rng = np.random.default_rng(3)
+    prompt = rng.integers(0, V, size=48).astype(np.int32)
+    src = _engine(model, params, role="prefill")
+    dst = _engine(model, params, role="decode")
+    exp, imp = _migrate(model, params, src, dst, prompt)
+    before = dst.pool.stats()
+    imp2 = dst.import_blocks(prompt, exp["blocks"])
+    # every chunk already cached: fresh blocks all freed again
+    assert dst.pool.stats() == before, (imp2, before)
+
+
+def test_call_in_loop_requires_running_loop(model_and_params):
+    model, params = model_and_params
+    eng = _engine(model, params)
+    with pytest.raises(TimeoutError, match="serve_forever"):
+        eng.call_in_loop(lambda: 1, timeout=0.1)
+
+
+def test_flight_records_migration_and_report_renders(
+        model_and_params, tmp_path, capsys):
+    """Per-tick export/import counts land in flight snapshots and
+    ``report --flight`` surfaces the migration line."""
+    from distkeras_tpu.telemetry.report import report_flight
+
+    model, params = model_and_params
+    rng = np.random.default_rng(4)
+    prompt = rng.integers(0, V, size=48).astype(np.int32)
+    src = _engine(model, params, role="prefill")
+    dst = _engine(model, params, role="decode")
+    _migrate(model, params, src, dst, prompt)
+    req = dst.submit(prompt, max_new_tokens=4)
+    dst.drain()
+    req.stream.tokens(timeout=120)
+    snaps = [r for r in dst.flight.snapshots()
+             if r.get("kind") == "tick"]
+    assert any(s.get("kv_imported") for s in snaps)
+    # export ran after src's last tick: counts attach to the NEXT tick
+    src.submit(prompt[:8], max_new_tokens=1)
+    src.drain()
+    exp_snaps = [r for r in src.flight.snapshots()
+                 if r.get("kind") == "tick"]
+    assert any(s.get("kv_exported") for s in exp_snaps)
+    path = tmp_path / "flight.jsonl"
+    dst.flight.dump(str(path))
+    report_flight(str(path))
+    out = capsys.readouterr().out
+    assert "kv migration:" in out
+    assert "blocks exported" in out
+
+
+# -- wire + router orchestration ---------------------------------------------
+
+
+def _fleet(model, params, roles, **eng_kw):
+    servers = [LMServer(_engine(model, params, role=r, **eng_kw)).start()
+               for r in roles]
+    return servers
+
+
+def test_router_disagg_end_to_end(model_and_params):
+    """Long prompts migrate (prefill replica computes, decode replica
+    serves off the imported prefix), short prompts avoid the prefill
+    pool, a repeated long prompt skips the redundant migration, and
+    every stream is bit-identical to solo generate."""
+    model, params = model_and_params
+    rng = np.random.default_rng(5)
+    long_p = rng.integers(0, V, size=128).astype(np.int32)
+    short_p = rng.integers(0, V, size=8).astype(np.int32)
+    servers = _fleet(model, params, ("prefill", "decode", "decode"),
+                     chunk=32)
+    router = Router(
+        [("127.0.0.1", s.port, f"r{i}") for i, s in enumerate(servers)],
+        block_size=BS, poll_interval=0.1, disagg_prompt_tokens=64,
+        registry=telemetry.MetricRegistry(), tracer=telemetry.Tracer(),
+    ).start()
+    try:
+        time.sleep(0.3)  # first poll round classifies the pools
+        c = ServingClient("127.0.0.1", router.port, request_timeout=120)
+        rid = c.generate(short_p, max_new_tokens=4)
+        toks, reason = c.result(rid, timeout=120)
+        assert (toks, reason) == (_want(model, params, short_p, 4),
+                                  "length")
+        # short traffic never lands on the prefill replica
+        assert servers[0].engine.requests_completed == 0
+        rid = c.generate(long_p, max_new_tokens=6)
+        toks, reason = c.result(rid, timeout=120)
+        assert (toks, reason) == (_want(model, params, long_p, 6),
+                                  "length")
+        st = c.stats()
+        assert st["router"]["kv_migrations"] == 1
+        assert st["kv_blocks_exported"] >= 1
+        assert st["kv_blocks_imported"] >= 1
+        # the prefill replica ran the throwaway 1-token pass
+        assert servers[0].engine.requests_completed == 1
+        # repeat: the decode pool owns the prefix now — no re-migration
+        rid = c.generate(long_p, max_new_tokens=6)
+        toks, _ = c.result(rid, timeout=120)
+        assert toks == _want(model, params, long_p, 6)
+        assert c.stats()["router"]["kv_migrations"] == 1
+        mig = router.metrics()["serving_kv_migrations_total"]
+        assert {tuple(s["labels"].items()): s["value"]
+                for s in mig["series"]} == {(("outcome", "ok"),): 1.0}
+        c.close()
+    finally:
+        router.stop()
+        for s in servers:
+            s.stop()
+
+
+def test_router_disagg_race_zero_lost_streams(model_and_params):
+    """Migration racing eviction: the prefill replica's pool holds
+    roughly one long prompt, and several distinct long prompts arrive
+    concurrently — whatever mix of migrations and fallbacks results,
+    every stream completes bit-identical and nothing is lost."""
+    model, params = model_and_params
+    rng = np.random.default_rng(6)
+    longs = [rng.integers(0, V, size=96).astype(np.int32)
+             for _ in range(4)]
+    pre = LMServer(_engine(model, params, role="prefill",
+                           num_blocks=16, chunk=32)).start()
+    decs = _fleet(model, params, ("decode", "decode"), chunk=32)
+    servers = [pre] + decs
+    router = Router(
+        [("127.0.0.1", s.port, f"r{i}") for i, s in enumerate(servers)],
+        block_size=BS, poll_interval=0.1, disagg_prompt_tokens=64,
+        registry=telemetry.MetricRegistry(), tracer=telemetry.Tracer(),
+    ).start()
+    try:
+        time.sleep(0.3)
+        c = ServingClient("127.0.0.1", router.port, request_timeout=180)
+        results = {}
+        lock = threading.Lock()
+
+        def run(i):
+            rid = c.generate(longs[i], max_new_tokens=4)
+            with lock:
+                results[i] = c.result(rid, timeout=180)
+
+        threads = [threading.Thread(target=run, args=(i,))
+                   for i in range(len(longs))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        assert len(results) == len(longs)
+        for i, (toks, reason) in results.items():
+            assert reason == "length", (i, reason)
+            assert toks == _want(model, params, longs[i], 4), i
+        st = c.stats()
+        assert st["router"]["failed"] == 0
+        assert st["router"]["kv_migrations"] == len(longs)
+        c.close()
+    finally:
+        router.stop()
+        for s in servers:
+            s.stop()
+
+
+def test_export_import_over_the_wire(model_and_params):
+    """The raw ops: export_kv against one LMServer, import_kv into
+    another, then a prefix-hit generate on the importer."""
+    model, params = model_and_params
+    rng = np.random.default_rng(7)
+    prompt = rng.integers(0, V, size=48).astype(np.int32)
+    s1 = LMServer(_engine(model, params, role="prefill")).start()
+    s2 = LMServer(_engine(model, params, role="decode")).start()
+    try:
+        c1 = ServingClient("127.0.0.1", s1.port, request_timeout=120)
+        c2 = ServingClient("127.0.0.1", s2.port, request_timeout=120)
+        rid = c1.generate(prompt, max_new_tokens=1)
+        c1.result(rid, timeout=120)
+        exp = c1.export_kv(prompt)
+        assert exp["tokens"] > 0 and exp["blocks"]
+        imp = c2.import_kv(prompt, exp["blocks"])
+        assert imp["imported"] == len(exp["blocks"])
+        assert imp["mode"] == "device"
+        rid = c2.generate(prompt, max_new_tokens=6)
+        toks, _ = c2.result(rid, timeout=120)
+        assert toks == _want(model, params, prompt, 6)
+        assert s2.engine.prefix_hit_tokens == imp["tokens"]
+        c1.close()
+        c2.close()
+    finally:
+        s1.stop()
+        s2.stop()
+
+
+def test_router_refuses_direct_kv_ops(model_and_params):
+    """export_kv/import_kv against the ROUTER answer a typed refusal
+    (migration is router-orchestrated), mirroring the flight op."""
+    model, params = model_and_params
+    servers = _fleet(model, params, ("mixed",))
+    router = Router(
+        [("127.0.0.1", servers[0].port, "r0")], block_size=BS,
+        poll_interval=0.1,
+        registry=telemetry.MetricRegistry(), tracer=telemetry.Tracer(),
+    ).start()
+    try:
+        c = ServingClient("127.0.0.1", router.port)
+        with pytest.raises(RuntimeError, match="orchestrated"):
+            c.export_kv([1, 2, 3])
+        with pytest.raises(RuntimeError, match="orchestrated"):
+            c.import_kv([1, 2, 3], [])
+        c.close()
+    finally:
+        router.stop()
+        for s in servers:
+            s.stop()
+
+
+# -- framing hardening (FrameError) ------------------------------------------
+
+
+def _sock_pair():
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    cli = socket.create_connection(srv.getsockname())
+    conn, _ = srv.accept()
+    srv.close()
+    return cli, conn
+
+
+def test_oversized_frame_raises_typed_error_naming_limit():
+    cli, conn = _sock_pair()
+    try:
+        # an 8-byte header announcing a frame far over the limit, with
+        # no payload behind it — the receiver must refuse BEFORE
+        # allocating, with the limit in the message
+        cli.sendall(struct.pack(">Q", 1 << 40))
+        with pytest.raises(FrameError, match="max_bytes=65536") as ei:
+            recv_msg(conn, max_bytes=65536)
+        assert ei.value.limit == 65536 and ei.value.size == 1 << 40
+    finally:
+        cli.close()
+        conn.close()
+
+
+def test_truncated_frame_raises_typed_error():
+    cli, conn = _sock_pair()
+    try:
+        # header promises 64 bytes, peer dies after 10: damage, not a
+        # clean EOF (the pre-typed behavior returned None here, making
+        # a torn KV payload indistinguishable from orderly shutdown)
+        cli.sendall(struct.pack(">Q", 64) + b"x" * 10)
+        cli.close()
+        with pytest.raises(FrameError, match="truncated"):
+            recv_msg(conn)
+    finally:
+        conn.close()
+
+
+def test_clean_eof_is_still_none():
+    cli, conn = _sock_pair()
+    try:
+        send_msg(cli, {"ok": 1})
+        assert recv_msg(conn) == {"ok": 1}
+        cli.close()
+        assert recv_msg(conn) is None
+    finally:
+        conn.close()
+
+
+def test_server_survives_malformed_frame_fuzz(model_and_params):
+    """Garbage frames — random bytes, oversized headers, truncated
+    payloads — against a live LMServer: the offending connection is
+    dropped, the server keeps serving everyone else."""
+    model, params = model_and_params
+    server = LMServer(_engine(model, params),
+                      max_frame_bytes=1 << 20).start()
+    try:
+        rng = np.random.default_rng(8)
+        payloads = [
+            b"\x00" * 3,                                   # short header
+            struct.pack(">Q", 1 << 50),                    # oversized
+            struct.pack(">Q", 512) + b"j" * 100,           # truncated
+            struct.pack(">Q", 32) + bytes(rng.integers(0, 256, 32)),
+        ]
+        for p in payloads:
+            s = socket.create_connection(("127.0.0.1", server.port))
+            s.sendall(p)
+            s.close()
+        # the server is still healthy for a well-formed client
+        c = ServingClient("127.0.0.1", server.port, request_timeout=60)
+        assert c.stats()["active_slots"] == 0
+        c.close()
+    finally:
+        server.stop()
+
+
+def test_client_max_frame_bytes_knob(model_and_params):
+    """A client whose frame bound is below an export_kv reply gets the
+    typed FrameError surfaced as a dead connection naming host:port —
+    not a hang, not an OOM."""
+    from distkeras_tpu.serving import ServingConnectionError
+
+    model, params = model_and_params
+    server = LMServer(_engine(model, params, role="prefill")).start()
+    try:
+        rng = np.random.default_rng(9)
+        prompt = rng.integers(0, V, size=48).astype(np.int32)
+        big = ServingClient("127.0.0.1", server.port,
+                            request_timeout=120)
+        rid = big.generate(prompt, max_new_tokens=1)
+        big.result(rid, timeout=120)
+        small = ServingClient("127.0.0.1", server.port,
+                              request_timeout=30,
+                              max_frame_bytes=256)
+        with pytest.raises((ServingConnectionError, TimeoutError)):
+            small.export_kv(prompt)
+        assert small.closed
+        small.close()
+        big.close()
+    finally:
+        server.stop()

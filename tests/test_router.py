@@ -403,6 +403,52 @@ def test_router_drain_and_replica_drain(model_and_params):
         _stop(servers, router, [client])
 
 
+def test_drain_forgets_affinity_placements(model_and_params):
+    """Regression: a *drained* replica's affinity placements must be
+    forgotten (previously only death forgot them), both when the drain
+    is admin-issued through the router and when the probe loop detects
+    an engine that began draining on its own — otherwise the radix
+    index keeps steering every same-prefix request at a replica that
+    refuses it."""
+    model, params = model_and_params
+    servers, router = _fleet(model, params, n=2, paged=True)
+    client = ServingClient("127.0.0.1", router.port)
+    try:
+        rng = np.random.default_rng(17)
+        prompt = rng.integers(0, 64, size=4 * BS).astype(np.int32)
+        rid = client.generate(prompt, max_new_tokens=2)
+        client.result(rid, timeout=60)
+        with router._route_lock:
+            owner, hit = router.index.lookup(prompt)
+        assert owner in ("r0", "r1") and hit > 0
+        # leg 1: admin drain through the wire op — placements must be
+        # gone IMMEDIATELY, not at the next poll
+        client.drain(replica=owner)
+        with router._route_lock:
+            owner2, _ = router.index.lookup(prompt)
+        assert owner2 is None
+        # traffic re-places on the survivor
+        rid = client.generate(prompt, max_new_tokens=2)
+        toks, _ = client.result(rid, timeout=60)
+        assert toks == _solo(model, params, prompt, 2)
+        survivor = "r1" if owner == "r0" else "r0"
+        with router._route_lock:
+            owner3, _ = router.index.lookup(prompt)
+        assert owner3 == survivor
+        # leg 2: the survivor's ENGINE begins draining on its own (a
+        # deploy agent drained it behind the router's back) — the
+        # probe loop must detect the transition and forget
+        idx = int(survivor[1:])
+        servers[idx].engine.begin_drain()
+        router.manager.probe_all()
+        assert router.manager.get(survivor).state == "draining"
+        with router._route_lock:
+            owner4, _ = router.index.lookup(prompt)
+        assert owner4 is None
+    finally:
+        _stop(servers, router, [client])
+
+
 # ---------------------------------------------------------------------------
 # typed overload + connection robustness (satellites)
 # ---------------------------------------------------------------------------

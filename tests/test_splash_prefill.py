@@ -1,0 +1,151 @@
+"""Splash chunked-prefill kernel: parity vs the dense reference, auto
+gating, and engine-level stream parity with the kernel forced.
+
+The kernel (ops/splash_prefill.py) runs in interpret mode off-TPU, so
+CPU CI executes the identical program the TPU would; the dense masked
+attend stays the bit-parity reference (``prefill_kernel='gather'``).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distkeras_tpu import telemetry
+from distkeras_tpu.ops import splash_prefill as sp
+from distkeras_tpu.serving import ServingEngine
+
+
+def _dense_ref(q, keys, vals, starts):
+    """The _cached_attend math: grouped masked attend at absolute
+    per-row positions."""
+    B, T, H, hd = q.shape
+    L, Hk = keys.shape[1], keys.shape[2]
+    G = H // Hk
+    scale = 1.0 / np.sqrt(hd)
+    qg = q.reshape(B, T, Hk, G, hd)
+    s = jnp.einsum("bqkgd,blkd->bkgql", qg, keys).astype(jnp.float32) * scale
+    qpos = starts[:, None] + jnp.arange(T)[None]
+    mask = jnp.arange(L)[None, None, :] <= qpos[..., None]
+    s = jnp.where(mask[:, None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bkgql,blkd->bqkgd", p.astype(q.dtype), vals)
+    return out.reshape(B, T, H, hd)
+
+
+@pytest.mark.parametrize("B,T,H,Hk,hd,L", [
+    (2, 8, 4, 2, 16, 64),    # GQA, chunk mid-cache
+    (3, 5, 4, 4, 8, 48),     # MHA, odd chunk, odd-tile L
+    (1, 16, 8, 2, 32, 128),  # wide group
+])
+def test_kernel_matches_dense_reference(B, T, H, Hk, hd, L):
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((B, T, H, hd)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, L, Hk, hd)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, L, Hk, hd)), jnp.float32)
+    starts = jnp.asarray(rng.integers(0, L - T, size=B), jnp.int32)
+    out = sp.splash_prefill_attention(q, k, v, starts)
+    ref = _dense_ref(q, k, v, starts)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_kernel_rows_at_distinct_depths():
+    """Each batch row at its own cursor — the mixed tick's shape: one
+    row deep into its context, one at the start (most KV tiles
+    skipped), one mid-way."""
+    rng = np.random.default_rng(1)
+    B, T, H, Hk, hd, L = 3, 4, 4, 2, 16, 96
+    q = jnp.asarray(rng.standard_normal((B, T, H, hd)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, L, Hk, hd)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, L, Hk, hd)), jnp.float32)
+    starts = jnp.asarray([0, 40, 90], jnp.int32)
+    out = sp.splash_prefill_attention(q, k, v, starts)
+    ref = _dense_ref(q, k, v, starts)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_supports_and_preferred_gating():
+    # lane-aligned shapes pass the static gate...
+    assert sp.supports(64, 2, 128, 1024)
+    # ...but a single decode token, a ragged query tile, an unaligned
+    # head dim, or an unaligned cache length never take the kernel
+    assert not sp.supports(1, 8, 128, 1024)
+    assert not sp.supports(3, 1, 128, 1024)
+    assert not sp.supports(64, 2, 96, 1024)
+    assert not sp.supports(64, 2, 128, 100)
+    # preferred() is supports() AND-gated on the TPU backend — on the
+    # CPU CI it must always keep 'auto' on the dense reference
+    if jax.default_backend() != "tpu":
+        assert not sp.preferred(64, 2, 128, 1024)
+
+
+def test_choose_kv_block_divides():
+    for L in (64, 96, 100, 128, 1024, 7):
+        assert L % sp.choose_kv_block(L) == 0
+
+
+def test_module_resolves_prefill_kernel():
+    from distkeras_tpu.models.transformer import CausalSelfAttention
+
+    m = CausalSelfAttention(num_heads=4, decode=True, cache_len=64,
+                            slot_cursor=True, prefill_kernel="gather")
+    assert not m._use_prefill_kernel(64, 2, 128, 1024)
+    m = m.clone(prefill_kernel="splash")
+    assert m._use_prefill_kernel(8, 2, 16, 64)
+    assert not m._use_prefill_kernel(1, 2, 16, 64)  # decode step: dense
+    m = m.clone(prefill_kernel="auto")
+    assert (m._use_prefill_kernel(64, 2, 128, 1024)
+            == sp.preferred(64, 2, 128, 1024))
+
+
+def test_unknown_prefill_kernel_rejected():
+    from distkeras_tpu.models import get_model
+
+    model = get_model(
+        "transformer_lm", vocab_size=32, d_model=32, num_heads=4,
+        num_layers=1, max_len=32, dtype=jnp.float32, attention="dense",
+    )
+    bad = model.clone(decode=True, slot_cursor=True,
+                      prefill_kernel="flash", parent=None)
+    with pytest.raises(ValueError, match="prefill_kernel"):
+        bad.init(jax.random.PRNGKey(0), jnp.zeros((1, 1), jnp.int32))
+
+
+def _mk_engine(model, params, *, paged, prefill_kernel):
+    kw = dict(paged=True, block_size=8, num_blocks=64) if paged else {}
+    return ServingEngine(
+        model, params, slots=2, prefill_chunk=8,
+        prefill_kernel=prefill_kernel,
+        registry=telemetry.MetricRegistry(), tracer=telemetry.Tracer(),
+        **kw,
+    )
+
+
+@pytest.mark.parametrize("paged", [False, True])
+def test_engine_streams_match_with_kernel_forced(paged):
+    """The acceptance bar: chunked-prefill streams with the splash
+    kernel forced (interpret mode on CPU) are token-identical to the
+    dense-reference engine across both cache layouts."""
+    from distkeras_tpu.models import get_model
+
+    model = get_model(
+        "transformer_lm", vocab_size=64, d_model=64, num_heads=4,
+        num_layers=2, max_len=64, dtype=jnp.float32, attention="dense",
+    )
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 4), jnp.int32))
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, 64, size=n).astype(np.int32)
+               for n in (19, 30)]
+
+    def run(prefill_kernel):
+        eng = _mk_engine(model, params, paged=paged,
+                         prefill_kernel=prefill_kernel)
+        reqs = [eng.submit(p, max_new_tokens=6, temperature=0.7, seed=i)
+                for i, p in enumerate(prompts)]
+        eng.drain()
+        return [r.stream.tokens(timeout=60) for r in reqs]
+
+    assert run("splash") == run("gather")
