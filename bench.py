@@ -318,7 +318,45 @@ def main():
     out.update(serve_pipeline_bench())
     out.update(serve_tier_bench())
     out.update(serve_disagg_bench())
+    out.update(serve_update_bench())
     print(json.dumps(out))
+
+
+def serve_update_bench():
+    """Live-weight-update numbers for the BENCH trajectory: ITL p99
+    during mid-flight fleet rolling updates vs the no-push baseline,
+    swap counts, and the SLO-burn auto-rollback result. Self-asserts
+    are off (``checks=False``) and errors are folded into the JSON,
+    same policy as the other serving lines."""
+    import os
+    import sys
+
+    sys.path.insert(
+        0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "benchmarks"))
+    try:
+        import serve_bench
+
+        r = serve_bench.run_live_update(smoke=True, checks=False)
+        return {
+            "serve_update_itl_p99_ratio": r["itl_p99_ratio"],
+            "serve_update_base_itl_ms_p99": r["base_itl_ms_p99"],
+            "serve_update_live_itl_ms_p99": r["live_itl_ms_p99"],
+            "serve_update_fleet_weight_swaps":
+                r["fleet_weight_swaps"],
+            "serve_update_streams_complete": r["streams_complete"],
+            "serve_update_parity": r["post_update_parity"],
+            "serve_update_steady_recompiles":
+                len(r["steady_recompiles"]),
+            "serve_update_rollback_fired": r["rollback_fired"],
+            "serve_update_rollback_s": r["rollback_s"],
+            "serve_update_canary_streams_lost":
+                r["canary_streams_lost"],
+            "serve_update_config": r["config"],
+        }
+    except Exception as e:  # error-folded: a live-update regression
+        # must land as a worse number, not a dead BENCH line
+        return {"serve_update_error": f"{type(e).__name__}: {e}"}
 
 
 def serve_disagg_bench():

@@ -507,10 +507,41 @@ def config15():
     }))
 
 
+def config16():
+    """Zero-downtime live weight updates: mid-flight fleet rolling
+    updates through the router (benchmarks/serve_bench.py
+    --live-update; the --smoke variant self-asserts zero dropped/
+    corrupted streams, post-update bit-parity, ITL p99 during swaps
+    within 10% of the no-push baseline, zero steady-state recompiles,
+    and an injected bad checkpoint triggering SLO-burn auto-rollback
+    with zero lost streams)."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import serve_bench
+
+    out = serve_bench.run_live_update(smoke=True)
+    print(json.dumps({
+        "config": 16, "metric": "serving_live_update_itl_p99_ratio",
+        "value": out["itl_p99_ratio"],
+        "unit": "x (ITL p99 during swaps / no-push baseline)",
+        "base_itl_ms_p99": out["base_itl_ms_p99"],
+        "live_itl_ms_p99": out["live_itl_ms_p99"],
+        "fleet_weight_swaps": out["fleet_weight_swaps"],
+        "streams_complete": out["streams_complete"],
+        "post_update_parity": out["post_update_parity"],
+        "rollback_fired": out["rollback_fired"],
+        "rollback_s": out["rollback_s"],
+        "canary_streams_lost": out["canary_streams_lost"],
+        "n_devices": out["n_devices"],
+        "backend": out["backend"],
+        "model": out["config"],
+        "data": "synthetic-live-update-closed-loop-trace",
+    }))
+
+
 CONFIGS = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5,
            6: config6, 7: config7, 8: config8, 9: config9, 10: config10,
            11: config11, 12: config12, 13: config13, 14: config14,
-           15: config15}
+           15: config15, 16: config16}
 
 
 def main():

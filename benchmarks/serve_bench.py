@@ -2164,6 +2164,373 @@ def run_disagg(smoke=False, replicas=3, checks=True):
     return json.loads(line)
 
 
+def bench_live_update(V=256, D=128, H=4, L=2, replicas=3, slots=2,
+                      prompt_len=16, max_new=32, n_requests=18,
+                      clients=3, block_size=16, n_updates=3,
+                      dtype="float32", smoke=False, checks=True):
+    """Zero-downtime live weight updates at the fleet level.
+
+    Three in-process LMServer replicas behind the Router serve a
+    closed loop of seeded greedy streams while the router performs
+    rolling weight updates (drain → chunked push → undrain, one
+    replica at a time) mid-flight. Three phases:
+
+    - **baseline**: the workload with no pushes — client-side exact
+      per-stream ITLs (every token timestamped at the client);
+    - **live-update**: the identical workload while ``n_updates``
+      fleet-wide rolling updates land mid-flight (alternating between
+      two same-shape weight sets; one rides the wire ``push_weights``
+      op, the rest the admin API). Every stream must complete with
+      its full token budget (zero dropped/corrupted), post-update
+      streams must be bit-identical to solo ``generate()`` on the
+      final weights, ITL p99 must stay within 10% of baseline (+ a
+      2.5 ms CPU-jitter floor), and the measured pass must stay at
+      zero steady-state recompiles — a weight swap changes traced
+      *values*, never compiled shapes;
+    - **rollback**: the SLO-burn auto-rollback, end to end with a real
+      quality canary. Each replica runs an :class:`SloMonitor` with
+      one burn-rate rule — the *rate of length-finishes* on canary
+      traffic that, under good weights, deterministically samples its
+      eos early (greedy; ``eos_id`` is read off solo ``generate()``).
+      An injected **bad checkpoint** (structurally valid, garbage
+      values — validation rightly accepts it) makes canaries run to
+      their full budget, the rule burns in every window, and the
+      router's armed guard re-pushes the previous version:
+      ``router_weight_rollbacks_total`` increments, canaries return
+      to eos-finishing, and zero streams are lost throughout.
+
+    ``--smoke`` self-asserts all of the above. Needs ``replicas``
+    devices — run via :func:`run_live_update` (forces virtual host
+    devices when short)."""
+    from distkeras_tpu import telemetry
+    from distkeras_tpu.models import get_model
+    from distkeras_tpu.models.transformer import generate
+    from distkeras_tpu.serving import (
+        LMServer, Router, ServingClient, ServingEngine,
+    )
+    from distkeras_tpu.telemetry.slo import SloMonitor, SloRule
+
+    if len(jax.devices()) < replicas:
+        raise RuntimeError(
+            f"bench_live_update wants {replicas} devices, have "
+            f"{len(jax.devices())} — run via --live-update (it forces "
+            f"host devices when short)"
+        )
+    max_len = prompt_len + max_new + 16
+    model = get_model(
+        "transformer_lm", vocab_size=V, d_model=D, num_heads=H,
+        num_layers=L, max_len=max_len, dtype=jnp.dtype(dtype),
+        attention="dense",
+    )
+    dummy = jnp.zeros((1, 4), jnp.int32)
+    good_a = model.init(jax.random.PRNGKey(0), dummy)
+    good_b = model.init(jax.random.PRNGKey(1), dummy)
+    # the "bad checkpoint": same tree, same shapes, garbage values —
+    # validation accepts it (as it should), only quality burns
+    bad = model.init(jax.random.PRNGKey(666), dummy)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, V, size=prompt_len).astype(np.int32)
+               for _ in range(n_requests)]
+
+    devices = jax.devices()
+    servers = []
+    for i in range(replicas):
+        reg = telemetry.MetricRegistry()
+        eng = ServingEngine(
+            model, good_a, slots=slots, paged=True,
+            block_size=block_size, registry=reg,
+            tracer=telemetry.Tracer(pid=1000 + i),
+            device=devices[i % len(devices)],
+        )
+        # the quality canary: under good weights the canary stream
+        # greedily samples its eos well inside the budget, so ANY
+        # sustained rate of length-finishes is a burned objective
+        slo = SloMonitor(
+            [SloRule("canary_length_rate", "serving_requests_total",
+                     "rate", 0.02, labels=(("reason", "length"),),
+                     windows=(1.5, 3.0), burn_threshold=0.5)],
+            registry=reg, tracer=eng.tracer, interval_s=0.25,
+        )
+        servers.append(LMServer(eng, slo=slo).start())
+    router = Router(
+        [("127.0.0.1", s.port, f"r{i}")
+         for i, s in enumerate(servers)],
+        block_size=block_size, poll_interval=0.1,
+        registry=telemetry.MetricRegistry(),
+        tracer=telemetry.Tracer(pid=1),
+    ).start()
+    client = ServingClient("127.0.0.1", router.port,
+                           request_timeout=600.0)
+
+    def refs(params):
+        return {
+            i: np.asarray(generate(
+                model, params, jnp.asarray(p)[None], max_new
+            ))[0, prompt_len:].tolist()
+            for i, p in enumerate(prompts[:4])
+        }
+
+    def run_phase(tag):
+        """Closed loop of `clients` workers over the prompt list;
+        returns per-stream (tokens, reason) + exact client-side
+        ITLs."""
+        lock = threading.Lock()
+        nxt = [0]
+        streams: dict = {}
+        itls: list = []
+
+        def worker():
+            while True:
+                with lock:
+                    if nxt[0] >= n_requests:
+                        return
+                    i = nxt[0]
+                    nxt[0] += 1
+                rid = client.generate(prompts[i],
+                                      max_new_tokens=max_new)
+                toks = []
+                reason = None
+                last_t = None
+                gaps = []
+                for kind, val in client.frames(rid, timeout=600):
+                    now = time.perf_counter()
+                    if kind == "end":
+                        reason = val
+                        break
+                    toks.append(val)
+                    if last_t is not None:
+                        gaps.append((now - last_t) * 1e3)
+                    last_t = now
+                with lock:
+                    streams[i] = (toks, reason)
+                    itls.extend(gaps)
+
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=900)
+        dt = time.perf_counter() - t0
+        arr = np.asarray(sorted(itls)) if itls else np.asarray([0.0])
+        return {
+            "tag": tag, "streams": streams, "makespan_s": dt,
+            "itl_p50": float(arr[int(0.50 * (len(arr) - 1))]),
+            "itl_p99": float(arr[int(0.99 * (len(arr) - 1))]),
+            "tokens": int(sum(len(t) for t, _ in streams.values())),
+        }
+
+    # warmup: compile every shape (cold + repeat prompt, decode), and
+    # one same-values push so nothing about the swap path is cold;
+    # then declare steady state — later re-traces are a bug
+    for _ in range(2):
+        rid = client.generate(prompts[0], max_new_tokens=4)
+        client.result(rid, timeout=600)
+    router.rolling_update(good_a, retry_timeout_s=120.0)
+    for s in servers:
+        s.engine.mark_steady()
+
+    base = run_phase("baseline")
+
+    # live-update phase: the same workload with mid-flight rolling
+    # updates — one through the wire op, the rest via the admin API
+    push_err: list = []
+
+    def pusher():
+        try:
+            pc = ServingClient("127.0.0.1", router.port,
+                               request_timeout=600.0)
+            sets = [good_b, good_a]
+            for u in range(n_updates):
+                time.sleep(0.3)
+                params = sets[u % 2]
+                if u == 0:
+                    pc.push_weights(params, chunk_bytes=256 << 10,
+                                    timeout=600.0)
+                else:
+                    router.rolling_update(params,
+                                          retry_timeout_s=120.0)
+            pc.close()
+        except Exception as e:  # surfaced in the JSON, fails smoke
+            push_err.append(f"{type(e).__name__}: {e}")
+
+    pt = threading.Thread(target=pusher, daemon=True)
+    pt.start()
+    live = run_phase("live")
+    pt.join(timeout=600)
+
+    final_params = [good_b, good_a][(n_updates - 1) % 2]
+    # post-update parity: fresh streams on the converged fleet are
+    # bit-identical to solo generate() on the final weights
+    want = refs(final_params)
+    post_parity = True
+    for i in want:
+        rid = client.generate(prompts[i], max_new_tokens=max_new)
+        toks, reason = client.result(rid, timeout=600)
+        post_parity = post_parity and toks == want[i] \
+            and reason == "length"
+    # every mid-flight stream completed with its full budget
+    complete = all(
+        reason == "length" and len(toks) == max_new
+        for toks, reason in live["streams"].values()
+    )
+    recomp: dict = {}
+    for s in servers:
+        recomp.update(s.engine.recompiles_since_mark())
+    fleet_stats = client.stats()
+    swaps_total = fleet_stats.get("weight_swaps")
+
+    # -- rollback phase: bad checkpoint → SLO burn → auto-rollback ----
+    canary_prompt = rng.integers(0, V, size=prompt_len).astype(np.int32)
+    canary_ref = np.asarray(generate(
+        model, final_params, jnp.asarray(canary_prompt)[None], max_new
+    ))[0, prompt_len:].tolist()
+    eos_id = int(canary_ref[3])  # the good weights emit this 4th
+    canary_stop = threading.Event()
+    canary_out: list = []
+
+    def canary_loop():
+        while not canary_stop.is_set():
+            try:
+                rid = client.generate(canary_prompt,
+                                      max_new_tokens=max_new,
+                                      eos_id=eos_id)
+                toks, reason = client.result(rid, timeout=600)
+                canary_out.append((time.monotonic(), reason,
+                                   len(toks)))
+            except Exception:
+                canary_out.append((time.monotonic(), "error", 0))
+            time.sleep(0.1)
+
+    # let the live/parity phases' legitimate length-finishes decay out
+    # of every burn window before arming the guard — the rollback must
+    # be attributable to the canary regression, not stale rates
+    time.sleep(3.5)
+    ct = threading.Thread(target=canary_loop, daemon=True)
+    ct.start()
+    time.sleep(1.0)  # a little good-weights canary history
+    # the bad push, guard armed on the fleet's per-replica monitors
+    t_bad = time.monotonic()
+    router.rolling_update(bad, guard_window_s=60.0,
+                          retry_timeout_s=120.0)
+    rollback_fired = False
+    deadline = time.monotonic() + 90
+    while time.monotonic() < deadline:
+        w = router.stats()["router"]["weights"]
+        if w["rollbacks"] >= 1:
+            rollback_fired = True
+            break
+        time.sleep(0.2)
+    t_rb = time.monotonic()
+    time.sleep(2.0)  # post-rollback canaries
+    canary_stop.set()
+    ct.join(timeout=30)
+    # canaries after the rollback finish on eos again (the previous
+    # weights are back); none errored/disconnected at any point
+    post_rb = [r for t, r, _ in canary_out if t > t_rb + 0.5]
+    canary_recovered = bool(post_rb) and all(r == "eos"
+                                             for r in post_rb)
+    canary_lost = sum(1 for _, r, _ in canary_out
+                      if r not in ("eos", "length"))
+    wfinal = router.stats()["router"]["weights"]
+
+    result = {
+        "base_itl_ms_p50": round(base["itl_p50"], 3),
+        "base_itl_ms_p99": round(base["itl_p99"], 3),
+        "live_itl_ms_p50": round(live["itl_p50"], 3),
+        "live_itl_ms_p99": round(live["itl_p99"], 3),
+        "itl_p99_ratio": (
+            round(live["itl_p99"] / base["itl_p99"], 3)
+            if base["itl_p99"] else None
+        ),
+        "updates_applied": n_updates + 1,  # + the warmup push
+        "fleet_weight_swaps": swaps_total,
+        "streams_complete": complete,
+        "post_update_parity": post_parity,
+        "push_errors": push_err,
+        "steady_recompiles": recomp,
+        "rollback_fired": rollback_fired,
+        "rollback_s": (round(t_rb - t_bad, 2) if rollback_fired
+                       else None),
+        "rollbacks_total": wfinal["rollbacks"],
+        "canary_recovered": canary_recovered,
+        "canary_streams_lost": canary_lost,
+        "canary_runs": len(canary_out),
+        "n_devices": len(jax.devices()),
+        "backend": jax.default_backend(),
+        "config": f"d{D}/h{H}/L{L}/v{V}-replicas{replicas}x{slots}"
+                  f"slots-new{max_new}-req{n_requests}-clients"
+                  f"{clients}-updates{n_updates}-{dtype}"
+                  + ("-smoke" if smoke else ""),
+    }
+    if smoke and checks:
+        # the live-update contract (ISSUE 15 acceptance): mid-flight
+        # fleet pushes with zero dropped/corrupted streams, post-swap
+        # bit-parity, ITL p99 during swaps within 10% of the no-push
+        # baseline (+ CPU-jitter floor), zero steady-state recompiles,
+        # and the injected bad checkpoint triggering auto-rollback
+        # with zero lost streams
+        assert result["push_errors"] == [], result
+        assert result["streams_complete"], result
+        assert result["post_update_parity"], result
+        assert result["steady_recompiles"] == {}, result
+        assert (result["live_itl_ms_p99"]
+                <= 1.10 * result["base_itl_ms_p99"] + 2.5), result
+        assert result["rollback_fired"], result
+        assert result["rollbacks_total"] >= 1, result
+        assert result["canary_recovered"], result
+        assert result["canary_streams_lost"] == 0, result
+    client.close()
+    router.stop()
+    for s in servers:
+        try:
+            s.stop()
+        except Exception:
+            pass
+    print(json.dumps(result), flush=True)
+    return result
+
+
+def run_live_update(smoke=False, replicas=3, checks=True):
+    """bench_live_update with the respawn pattern of
+    :func:`run_router`: forces virtual host devices when the process
+    has fewer than ``replicas`` so each replica engine owns one."""
+    if len(jax.devices()) >= replicas:
+        return bench_live_update(smoke=smoke, replicas=replicas,
+                                 checks=checks)
+
+    import subprocess
+
+    env = dict(os.environ)
+    flags = " ".join(
+        f for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    )
+    env["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={replicas}"
+    ).strip()
+    env["JAX_PLATFORMS"] = "cpu"
+    cmd = [sys.executable, os.path.abspath(__file__), "--live-update",
+           "--replicas", str(replicas)]
+    if smoke:
+        cmd.append("--smoke")
+    if not checks:
+        cmd.append("--no-checks")
+    proc = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                          timeout=2400)
+    sys.stderr.write(proc.stderr)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"live-update bench subprocess failed "
+            f"(rc={proc.returncode}):\n{proc.stderr[-2000:]}\n"
+            f"{proc.stdout[-2000:]}"
+        )
+    line = [ln for ln in proc.stdout.splitlines() if ln.startswith("{")][-1]
+    print(line, flush=True)
+    return json.loads(line)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--slots", type=int, default=8)
@@ -2254,9 +2621,18 @@ def main():
                          "parity, eviction-race zero-lost; forces "
                          "virtual host devices when the process is "
                          "short")
+    ap.add_argument("--live-update", action="store_true",
+                    help="zero-downtime live weight update bench: "
+                         "mid-flight fleet rolling updates (drain → "
+                         "chunked push → undrain) with zero dropped/"
+                         "corrupted streams, ITL p99 within 10%% of "
+                         "the no-push baseline, and an injected bad "
+                         "checkpoint triggering SLO-burn auto-"
+                         "rollback; forces virtual host devices when "
+                         "the process is short")
     ap.add_argument("--replicas", type=int, default=3,
-                    help="replica count for --router/--disagg "
-                         "(default 3)")
+                    help="replica count for --router/--disagg/"
+                         "--live-update (default 3)")
     ap.add_argument("--no-checks", action="store_true",
                     help="disable the --smoke self-asserts (used by "
                          "the flagship bench.py fold, where a fabric "
@@ -2269,6 +2645,14 @@ def main():
         if args.prefill_chunk is not None:
             kw["prefill_chunk"] = args.prefill_chunk
         bench_pipeline(**kw)
+        return
+    if args.live_update:
+        kw = dict(smoke=args.smoke, replicas=args.replicas,
+                  checks=not args.no_checks)
+        if len(jax.devices()) >= args.replicas:
+            bench_live_update(**kw)
+        else:
+            run_live_update(**kw)
         return
     if args.disagg:
         kw = dict(smoke=args.smoke, replicas=args.replicas,

@@ -38,6 +38,44 @@ import numpy as np
 import orbax.checkpoint as ocp
 
 
+class CheckpointMismatchError(ValueError):
+    """A ``restore(like=)`` template does not fit the checkpoint on
+    disk: the first offending leaf — wrong shape, wrong dtype, or
+    present on only one side — is named with its key path and both
+    specs. The pre-typed behavior was silent: orbax restores the
+    *saved* shapes regardless of the template, and the mismatch
+    surfaced later as a bare broadcast error deep inside whatever
+    jitted step first consumed the weights, far from the cause.
+    ``leaf`` carries the key path structurally."""
+
+    def __init__(self, msg, leaf=None):
+        super().__init__(msg)
+        self.leaf = leaf
+
+
+def _meta_spec(leaf):
+    """(shape, dtype) of an orbax metadata leaf or a template array."""
+    shape = tuple(getattr(leaf, "shape", None) or ())
+    dtype = getattr(leaf, "dtype", None)
+    return shape, (np.dtype(dtype) if dtype is not None else None)
+
+
+def _norm_path(path) -> str:
+    """Key path → a normalized string: orbax metadata renders a
+    namedtuple field as a dict key while the live template keeps the
+    attribute (``.mu`` vs ``['mu']``), so the raw ``keystr`` forms
+    never compare equal — normalize every entry down to its name."""
+    parts = []
+    for k in path:
+        name = getattr(k, "key", None)
+        if name is None:
+            name = getattr(k, "name", None)   # GetAttrKey
+        if name is None:
+            name = getattr(k, "idx", None)    # SequenceKey
+        parts.append(str(name))
+    return "/".join(parts)
+
+
 class Checkpointer:
     """Thin wrapper over an orbax ``CheckpointManager``.
 
@@ -92,7 +130,13 @@ class Checkpointer:
 
         ``like`` is a template with the target structure — required to
         reconstruct non-dict pytree nodes (optax NamedTuple states, tuples);
-        without it the state comes back as raw nested containers.
+        without it the state comes back as raw nested containers. The
+        template is validated against the checkpoint's on-disk metadata
+        BEFORE any array is read: a leaf whose shape or dtype disagrees
+        (or that exists on only one side) raises a typed
+        :class:`CheckpointMismatchError` naming it — orbax itself would
+        silently restore the saved shapes and let the mismatch explode
+        as a broadcast error far from the cause.
         """
         step = step if step is not None else self.latest_step
         if step is None:
@@ -104,12 +148,69 @@ class Checkpointer:
                 if like.get("opt_state") is not None else {},
                 "extra": dict(like.get("extra") or {}),
             }
+            self._validate_template(step, template)
             state = self._mgr.restore(
                 step, args=ocp.args.StandardRestore(template)
             )
         else:
-            state = self._mgr.restore(step)
+            try:
+                state = self._mgr.restore(step)
+            except Exception:
+                # orbax versions that refuse an args-less restore of a
+                # StandardSave item: template-free standard restore
+                state = self._mgr.restore(
+                    step, args=ocp.args.StandardRestore()
+                )
         return step, state
+
+    def _validate_template(self, step: int, template: dict):
+        """Template vs the checkpoint's on-disk metadata, leaf by leaf
+        (in the template's flatten order; no array data is read). The
+        first divergence raises :class:`CheckpointMismatchError`."""
+        try:
+            meta = self._mgr.item_metadata(step)
+        except Exception:
+            return  # no metadata on this orbax version: restore as-is
+        if meta is None:
+            return
+        tpl_leaves = {
+            _norm_path(p): leaf
+            for p, leaf in jax.tree_util.tree_flatten_with_path(
+                template)[0]
+        }
+        meta_leaves = {
+            _norm_path(p): leaf
+            for p, leaf in jax.tree_util.tree_flatten_with_path(meta)[0]
+        }
+        for path, leaf in tpl_leaves.items():
+            saved = meta_leaves.get(path)
+            if saved is None:
+                raise CheckpointMismatchError(
+                    f"checkpoint step {step} has no leaf {path} "
+                    f"(template expects shape "
+                    f"{tuple(np.shape(leaf))})", leaf=path,
+                )
+            want_shape, want_dtype = _meta_spec(leaf)
+            got_shape, got_dtype = _meta_spec(saved)
+            if want_shape != got_shape:
+                raise CheckpointMismatchError(
+                    f"checkpoint step {step} mismatch at leaf {path}: "
+                    f"saved shape {got_shape} != template shape "
+                    f"{want_shape}", leaf=path,
+                )
+            if (want_dtype is not None and got_dtype is not None
+                    and want_dtype != got_dtype):
+                raise CheckpointMismatchError(
+                    f"checkpoint step {step} mismatch at leaf {path}: "
+                    f"saved dtype {got_dtype} != template dtype "
+                    f"{want_dtype}", leaf=path,
+                )
+        for path in meta_leaves:
+            if path not in tpl_leaves:
+                raise CheckpointMismatchError(
+                    f"checkpoint step {step} carries leaf {path} the "
+                    f"template does not have", leaf=path,
+                )
 
     def close(self):
         self._mgr.wait_until_finished()

@@ -121,6 +121,176 @@ def native_transport_active() -> bool:
 
 
 # ---------------------------------------------------------------------------
+# Fault injection (chaos-test seam)
+# ---------------------------------------------------------------------------
+
+class FaultRule:
+    """One deterministic fault: fire on the ``nth`` matching frame.
+
+    ``direction`` is ``"send"`` or ``"recv"``; ``min_bytes`` narrows
+    the match to frames at least that large (how a test targets "the
+    Nth weight-push chunk" without the transport understanding ops —
+    push chunks dwarf every control frame). ``action``:
+
+    - ``"drop"``  — the frame is silently not sent (the peer's
+      request-level timeout is what notices);
+    - ``"delay"`` — sleep ``delay_s`` before sending (jitter/stall);
+    - ``"truncate"`` — send the full-length header but only half the
+      payload, then shut the socket down: the peer observes a typed
+      :class:`FrameError` (a torn frame, not a clean EOF);
+    - ``"kill"``  — shut the connection down and raise
+      ``ConnectionError`` at the caller (the connection dies exactly
+      at this frame).
+
+    ``repeat=True`` keeps firing on every later match too;
+    ``prob`` (with the injector's seeded RNG) fires each match with
+    that probability instead of deterministically at ``nth``.
+    ``matched``/``fired`` count for assertions."""
+
+    ACTIONS = ("drop", "delay", "truncate", "kill")
+
+    def __init__(self, action: str, direction: str = "send",
+                 nth: int = 1, min_bytes: int = 0,
+                 repeat: bool = False, delay_s: float = 0.05,
+                 prob: Optional[float] = None):
+        if action not in self.ACTIONS:
+            raise ValueError(
+                f"unknown fault action {action!r}: want one of "
+                f"{self.ACTIONS}"
+            )
+        if direction not in ("send", "recv"):
+            raise ValueError(
+                f"direction must be 'send' or 'recv'; got {direction!r}"
+            )
+        if nth < 1:
+            raise ValueError(f"nth must be >= 1; got {nth}")
+        self.action = action
+        self.direction = direction
+        self.nth = nth
+        self.min_bytes = min_bytes
+        self.repeat = repeat
+        self.delay_s = delay_s
+        self.prob = prob
+        self.matched = 0
+        self.fired = 0
+
+
+class FaultInjector:
+    """Deterministic, seeded fault injection for the framed transport.
+
+    Installed process-wide (:func:`install_fault_injector`), consulted
+    by :func:`send_frame` / :func:`recv_frame` on every frame — zero
+    overhead when nothing is installed (one ``is None`` check). Rules
+    are evaluated in insertion order under one lock, so concurrent
+    connections observe one consistent frame count; with a fixed seed
+    and a fixed frame sequence the fired faults are reproducible,
+    which is what lets the chaos tests assert exact outcomes
+    (replica dies at the Nth push chunk → fleet converges on
+    reconnect) instead of flaky ones."""
+
+    def __init__(self, seed: int = 0):
+        import random as _random
+
+        self.rng = _random.Random(seed)
+        self.rules = []
+        self._lock = threading.Lock()
+
+    def rule(self, action: str, **kw) -> FaultRule:
+        r = FaultRule(action, **kw)
+        with self._lock:
+            self.rules.append(r)
+        return r
+
+    def check(self, direction: str, nbytes: int):
+        """First rule firing for this frame, or None. Counts matches."""
+        with self._lock:
+            for r in self.rules:
+                if r.direction != direction or nbytes < r.min_bytes:
+                    continue
+                r.matched += 1
+                if r.prob is not None:
+                    fire = self.rng.random() < r.prob
+                else:
+                    fire = (r.matched == r.nth
+                            or (r.repeat and r.matched >= r.nth))
+                if fire:
+                    r.fired += 1
+                    return r
+        return None
+
+
+_fault_injector: Optional[FaultInjector] = None
+
+
+def install_fault_injector(fi: FaultInjector):
+    """Arm ``fi`` for every framed send/recv in this process (chaos
+    tests only; tests must :func:`uninstall_fault_injector` in
+    teardown so faults cannot leak across tests)."""
+    global _fault_injector
+    _fault_injector = fi
+
+
+def uninstall_fault_injector():
+    global _fault_injector
+    _fault_injector = None
+
+
+def _inject_send(sock: socket.socket, payload: bytes) -> bool:
+    """Apply any armed send-side fault. Returns True when the frame
+    was consumed by the fault (caller must not send it)."""
+    fi = _fault_injector
+    if fi is None:
+        return False
+    r = fi.check("send", len(payload))
+    if r is None:
+        return False
+    if r.action == "drop":
+        return True
+    if r.action == "delay":
+        time.sleep(r.delay_s)
+        return False
+    if r.action == "truncate":
+        try:
+            sock.sendall(struct.pack(">Q", len(payload))
+                         + payload[:len(payload) // 2])
+        except OSError:
+            pass
+        try:
+            sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        raise ConnectionError(
+            "fault injected: frame truncated mid-payload"
+        )
+    # kill
+    try:
+        sock.shutdown(socket.SHUT_RDWR)
+    except OSError:
+        pass
+    raise ConnectionError("fault injected: connection killed")
+
+
+def _inject_recv(sock: socket.socket):
+    """Apply any armed recv-side fault (kill/delay; size-blind — the
+    header has not been read yet)."""
+    fi = _fault_injector
+    if fi is None:
+        return
+    r = fi.check("recv", 0)
+    if r is None:
+        return
+    if r.action == "delay":
+        time.sleep(r.delay_s)
+        return
+    if r.action in ("kill", "truncate", "drop"):
+        try:
+            sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        raise ConnectionError("fault injected: connection killed")
+
+
+# ---------------------------------------------------------------------------
 # Framing (reference: send_data / recv_data)
 # ---------------------------------------------------------------------------
 
@@ -157,6 +327,8 @@ def _native_usable(sock: socket.socket):
 
 
 def send_frame(sock: socket.socket, payload: bytes):
+    if _fault_injector is not None and _inject_send(sock, payload):
+        return  # frame consumed by an injected drop
     lib = _native_usable(sock)
     if lib:
         rc = lib.dk_send_frame(sock.fileno(), payload, len(payload))
@@ -175,6 +347,8 @@ def recv_frame(
     ``max_bytes`` raise :class:`FrameError` naming the limit instead of
     allocating, and an EOF mid-frame raises it too (a truncated frame
     is damage, not shutdown); callers drop the connection either way."""
+    if _fault_injector is not None:
+        _inject_recv(sock)
     lib = _native_usable(sock)
     if lib:
         size = lib.dk_recv_frame_size(sock.fileno())

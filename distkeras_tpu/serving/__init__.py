@@ -54,6 +54,14 @@ from distkeras_tpu.serving.fleet import (  # noqa: F401
     merge_metric_snapshots,
 )
 from distkeras_tpu.serving.router import Router  # noqa: F401
+from distkeras_tpu.serving.weights import (  # noqa: F401
+    CheckpointWatcher,
+    ParameterServerFeed,
+    WeightPushError,
+    deserialize_weights,
+    serialize_weights,
+    validate_like,
+)
 
 __all__ = [
     "ServingEngine",
@@ -79,4 +87,10 @@ __all__ = [
     "ReplicaManager",
     "merge_metric_snapshots",
     "Router",
+    "WeightPushError",
+    "serialize_weights",
+    "deserialize_weights",
+    "validate_like",
+    "CheckpointWatcher",
+    "ParameterServerFeed",
 ]

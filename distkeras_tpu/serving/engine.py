@@ -94,6 +94,7 @@ from distkeras_tpu.telemetry.runtime import MemoryWatermarks, recompiles
 from distkeras_tpu.telemetry.slo import StallWatchdog
 from distkeras_tpu.serving.kvpool import BlockPool, HostBlockPool
 from distkeras_tpu.serving.prefix import RadixPrefixIndex
+from distkeras_tpu.serving.weights import validate_like
 from distkeras_tpu.serving.scheduler import (
     DEFAULT_PREFILL_CHUNK,
     DrainingError,
@@ -1455,6 +1456,15 @@ class ServingEngine:
         self.kv_blocks_imported = 0
         self._tick_exported = 0
         self._tick_imported = 0
+        # live weight updates: a monotonically increasing version
+        # stamped into stats(), trace spans, and flight snapshots —
+        # every streamed token is attributable to the weight set that
+        # produced it. update_weights (engine-thread-only; the
+        # push_weights wire op marshals through call_in_loop) swaps
+        # the double-buffered params tree between ticks.
+        self.weight_version = 1
+        self.weight_swaps = 0
+        self._m_weight_version.set(1)
 
     def _init_mesh_ctx(self):
         """Shard the device-side engine state onto the mesh and build
@@ -1479,6 +1489,10 @@ class ServingEngine:
 
         pspec = lm_param_specs(self._params_only, tp_axis=axis)
         cspec = serving_cache_specs(self._cache, tp_axis=axis)
+        # kept for live weight updates: a pushed tree re-shards onto
+        # the mesh with exactly the serving layout (reshard-on-upload,
+        # same pattern as the tiered-cache restore path)
+        self._param_shardings = named(pspec)
         self._params_only = jax.device_put(self._params_only,
                                            named(pspec))
         self._cache = jax.device_put(self._cache, named(cspec))
@@ -1650,6 +1664,20 @@ class ServingEngine:
             labelnames=("phase",))
         self._m_cp = {ph: self._m_critical.labels(phase=ph)
                       for ph in ("queue", "prefill", "decode", "device")}
+        # live weight updates (the train→serve loop): the currently
+        # served weight version, swap count, and how long each atomic
+        # hot swap took (validation + staged device upload + rebind)
+        self._m_weight_version = reg.gauge(
+            "serving_weight_version",
+            "monotonically increasing version of the live weights "
+            "(bumped by every push_weights swap)")
+        self._m_weight_swaps = reg.counter(
+            "serving_weight_swaps_total",
+            "atomic weight hot swaps applied at the tick boundary")
+        self._m_weight_swap_ms = reg.histogram(
+            "serving_weight_swap_ms",
+            "one weight swap: validation, staged host→device upload "
+            "dispatch, and the params rebind (ms)")
 
     # -- submission ---------------------------------------------------------
 
@@ -1826,12 +1854,80 @@ class ServingEngine:
         ``drain`` op (:meth:`ServingClient.drain`)."""
         self.draining = True
 
+    def end_drain(self):
+        """Reopen admissions after :meth:`begin_drain` — the undrain
+        half of the rolling-update primitive (drain → push weights →
+        undrain). Idempotent; served over TCP as the ``drain`` op's
+        ``undrain`` field (:meth:`ServingClient.undrain`)."""
+        self.draining = False
+
     @property
     def drained(self) -> bool:
         """True once a draining engine has finished all accepted work
         (no queued requests, every slot free)."""
         return (self.draining and self.scheduler.depth() == 0
                 and all(st is None for st in self._slots))
+
+    def update_weights(self, variables, version: Optional[int] = None,
+                       ) -> dict:
+        """Atomic live weight swap, applied at the tick boundary.
+
+        Engine-thread-only (like :meth:`export_blocks`): TCP handler
+        threads marshal through :meth:`call_in_loop` — the
+        ``push_weights`` wire op does — so the swap always lands
+        *between* ticks with no locks anywhere near the hot path. In
+        pipelined mode that boundary is the top of the next step: the
+        in-flight tick was dispatched with a reference to the old tree
+        and completes on it untouched (old-version completion is the
+        documented invariant); the next dispatch picks up the new
+        tree. The swap itself is double-buffered — the pushed host
+        tree is staged onto the device (re-sharded onto the mesh per
+        the serving param specs under tensor parallelism, pinned to
+        the replica's device otherwise) while the old tree keeps
+        serving, then one host pointer rebind makes it live. Ticks
+        are compiled over the params *shapes*, which validation pins
+        equal, so a swap can never cause a steady-state recompile.
+
+        ``variables`` is the model's variables dict (``{"params":
+        ...}``; a bare params tree is wrapped). Structure, shapes, and
+        dtypes must match the current weights exactly — the first
+        mismatched leaf raises a typed
+        :class:`~distkeras_tpu.serving.WeightPushError` *before*
+        anything is touched. A draft model's weights are not updated
+        (push the flagship only; restart to change the drafter).
+
+        ``version`` stamps the new weights (a checkpoint step, a PS
+        commit count); the engine keeps its version monotonic — a
+        stale or absent version still bumps by one, so every swap is
+        observable. Returns ``{"version", "swap_ms"}``."""
+        t0 = time.perf_counter()
+        if not (isinstance(variables, dict) and "params" in variables):
+            variables = {"params": variables}
+        validate_like(self._params_only["params"], variables["params"])
+        new = {"params": variables["params"]}
+        if self.mesh is not None:
+            new = jax.device_put(new, self._param_shardings)
+        else:
+            new = jax.device_put(new, self._device)
+        # the rebind IS the swap: in-flight dispatches hold their own
+        # reference to the old tree (params are never donated), so
+        # they complete on the old version while new dispatches read
+        # the new one
+        self._params_only = new
+        if version is not None and int(version) > self.weight_version:
+            self.weight_version = int(version)
+        else:
+            self.weight_version += 1
+        self.weight_swaps += 1
+        swap_ms = (time.perf_counter() - t0) * 1e3
+        self._m_weight_version.set(self.weight_version)
+        self._m_weight_swaps.inc()
+        self._m_weight_swap_ms.observe(swap_ms)
+        self.tracer.record(0, "serving.weight_swap", time.monotonic(),
+                           0.0, wv=self.weight_version,
+                           swap_ms=round(swap_ms, 3))
+        return {"version": self.weight_version,
+                "swap_ms": round(swap_ms, 3)}
 
     def watchdog(self, timeout_s: float = 30.0,
                  interval_s: Optional[float] = None) -> StallWatchdog:
@@ -2004,7 +2100,8 @@ class ServingEngine:
         req.admit_t = now
         self.tracer.record(req.trace_id, "queued", req.submit_t,
                            (now - req.submit_t) * 1e3,
-                           parent=req.parent_span)
+                           parent=req.parent_span,
+                           wv=self.weight_version)
         if self.prefill_chunk is not None:
             self._chunked_enter(slot, req, now)
             return
@@ -2033,7 +2130,8 @@ class ServingEngine:
         prefill_ms = (time.perf_counter() - t0) * 1e3
         req.prefill_done_t = time.monotonic()
         self.tracer.record(req.trace_id, "prefill", now, prefill_ms,
-                           slot=slot, prompt_tokens=int(req.prompt.size))
+                           slot=slot, prompt_tokens=int(req.prompt.size),
+                           wv=self.weight_version)
         self._m_prefill_ms.observe(prefill_ms)
 
     def _paged_attach_blocks(self, req: Request):
@@ -2147,7 +2245,8 @@ class ServingEngine:
         req.prefill_done_t = time.monotonic()
         self.tracer.record(req.trace_id, "prefill", now, prefill_ms,
                            slot=slot, prompt_tokens=Tp,
-                           cached_tokens=cached, blocks=len(chain))
+                           cached_tokens=cached, blocks=len(chain),
+                           wv=self.weight_version)
         self._m_prefill_ms.observe(prefill_ms)
 
     # -- chunked prefill (the fused mixed tick) -----------------------------
@@ -2633,6 +2732,7 @@ class ServingEngine:
                         prompt_tokens=int(req.prompt.size),
                         cached_tokens=st.cached_tokens,
                         chunk=self.prefill_chunk,
+                        wv=self.weight_version,
                     )
                     self._m_prefill_ms.observe(prefill_ms)
                 continue
@@ -2960,6 +3060,7 @@ class ServingEngine:
                         prompt_tokens=int(req.prompt.size),
                         cached_tokens=st.cached_tokens,
                         chunk=self.prefill_chunk,
+                        wv=self.weight_version,
                     )
                     self._m_prefill_ms.observe(prefill_ms)
                 continue
@@ -3112,11 +3213,13 @@ class ServingEngine:
             req.trace_id, "decode", decode_t0, decode_ms,
             slot=slot, tokens=req.n_emitted,
             device_ms=round(device_ms, 3),
+            wv=self.weight_version,
         )
         self.tracer.record(
             req.trace_id, "finish", req.done_t, 0.0,
             reason=reason, slot=slot, tokens=req.n_emitted,
             ttft_ms=round((req.first_token_t - req.submit_t) * 1e3, 3),
+            wv=self.weight_version,
         )
         # critical-path attribution: the engine-visible phases of this
         # request's wall time (the stream tail and router overhead are
@@ -3262,6 +3365,10 @@ class ServingEngine:
                 "emitted": emitted,
                 "slots": self._slot_snaps(),
                 "recompiles": rec_total,
+                # the weight set this tick served: a swap between two
+                # snapshots is visible as the version stepping (the
+                # report renderer's w=vN column)
+                "weight_version": self.weight_version,
             }
             if device_wait_ms is not None:
                 # overlap decomposition: device_ms = dispatch_ms (host
@@ -3328,6 +3435,11 @@ class ServingEngine:
             # polls for drained before stopping the process)
             "draining": self.draining,
             "drained": self.drained,
+            # live weight updates: the version currently serving and
+            # how many atomic hot swaps this engine has applied — the
+            # router's rolling updates poll this for convergence
+            "weight_version": self.weight_version,
+            "weight_swaps": self.weight_swaps,
             "mean_occupancy": (
                 round(self._occ_sum / self.ticks, 3) if self.ticks else 0.0
             ),

@@ -54,7 +54,7 @@ _SUM_KEYS = (
     "active_slots", "prompt_tokens", "prefix_hit_tokens",
     "blocks_in_use", "blocks_free", "blocks_reclaimable",
     "draft_tokens", "accepted_tokens", "decode_stalls",
-    "kv_blocks_exported", "kv_blocks_imported",
+    "kv_blocks_exported", "kv_blocks_imported", "weight_swaps",
 )
 
 
@@ -205,7 +205,8 @@ class ReplicaManager:
                  backoff_max: float = 5.0,
                  registry: Optional[telemetry.MetricRegistry] = None,
                  on_down: Optional[Callable[[Replica], None]] = None,
-                 on_drain: Optional[Callable[[Replica], None]] = None):
+                 on_drain: Optional[Callable[[Replica], None]] = None,
+                 probe_fault: Optional[Callable[[Replica], bool]] = None):
         if not replicas:
             raise ValueError("ReplicaManager needs at least one replica")
         names = [r.name for r in replicas]
@@ -225,6 +226,12 @@ class ReplicaManager:
         # that refuses it — previously only death forgot them, and a
         # drained replica kept attracting its whole prefix keyspace
         self.on_drain = on_drain
+        # fault-injection seam (chaos tests): consulted before each
+        # probe round trip; returning True makes that probe fail as if
+        # the replica were unreachable — deterministic replica-death
+        # injection without touching any socket (the transport-level
+        # twin is networking.FaultInjector)
+        self.probe_fault = probe_fault
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._m_up = self.registry.gauge(
@@ -286,6 +293,10 @@ class ReplicaManager:
         if r.state == DOWN and now < r.next_attempt_t:
             return
         try:
+            if self.probe_fault is not None and self.probe_fault(r):
+                raise ConnectionError(
+                    f"injected probe fault on {r.name}"
+                )
             client = r.client
             if client is None or client.closed:
                 client = r.connect()

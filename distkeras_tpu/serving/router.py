@@ -96,6 +96,7 @@ from distkeras_tpu.telemetry.trace import merge_span_chains
 from distkeras_tpu.serving.fleet import (
     DOWN,
     DRAINING,
+    HEALTHY,
     Replica,
     ReplicaManager,
     merge_metric_snapshots,
@@ -110,6 +111,7 @@ from distkeras_tpu.serving.server import (
     ServingConnectionError,
     shutdown_close,
 )
+from distkeras_tpu.serving.weights import WeightPushError
 
 
 class _HashRing:
@@ -306,6 +308,19 @@ class Router:
         ``trace_dump`` round trip per completed request, off the
         stream's critical path; ``archive_traces=False`` disables —
         ``trace_dump`` then answers only from live rings).
+      rollback_guard_window_s: arm SLO-burn auto-rollback after every
+        completed rolling weight update (:meth:`rolling_update` and
+        the ``push_weights`` wire op): for this many seconds the
+        router watches the fleet's SLO alerts, and the first firing
+        rule triggers an automatic re-push of the *previous* weight
+        version (``router_weight_rollbacks_total`` counts them).
+        ``None`` (default) disables the guard unless a per-call
+        ``guard_window_s`` is given.
+      rollback_monitor: alert source for the guard — any object with
+        an ``alerts()`` method (an
+        :class:`~distkeras_tpu.telemetry.SloMonitor` over router-side
+        metrics); default ``None`` polls the per-replica SLO monitors
+        through ``manager.aggregate_alerts()``.
     """
 
     def __init__(self, replicas: Sequence, host: str = "127.0.0.1",
@@ -325,6 +340,8 @@ class Router:
                  tracer: Optional[telemetry.Tracer] = None,
                  archive_traces: bool = True,
                  archive_capacity: int = 512,
+                 rollback_guard_window_s: Optional[float] = None,
+                 rollback_monitor=None,
                  seed: int = 0):
         if policy not in ("affine", "hash", "random"):
             raise ValueError(
@@ -443,6 +460,34 @@ class Router:
             labelnames=("phase",),
         )
         self._m_cp_router = self._m_critical.labels(phase="router")
+        # live weight updates (rolling deploys): one rolling update at
+        # a time (_update_serial); the version/payload history and all
+        # counters live in ONE dict rebound atomically per update
+        # (readers snapshot self._weights — the rebind-not-mutate
+        # discipline, no lock on any read path). rollback_guard_window_s
+        # arms the SLO-burn auto-rollback after every completed fleet
+        # update: if the fleet's burn-rate rules fire within the
+        # window, the previous version is re-pushed automatically.
+        # rollback_monitor overrides the alert source (default: the
+        # per-replica SLO monitors via manager.aggregate_alerts).
+        self._update_serial = threading.Lock()
+        self.rollback_guard_window_s = rollback_guard_window_s
+        self.rollback_monitor = rollback_monitor
+        self._weights: Dict = {
+            "version": 0, "current": None, "prev": None,
+            "updates": 0, "rollbacks": 0, "guard_deadline": None,
+            "last": None,
+        }
+        self._m_weight_updates = self.registry.counter(
+            "router_weight_updates_total",
+            "fleet rolling weight updates, by outcome",
+            labelnames=("outcome",),
+        )
+        self._m_weight_rollbacks = self.registry.counter(
+            "router_weight_rollbacks_total",
+            "automatic re-pushes of the previous weight version after "
+            "an SLO burn inside the post-update guard window",
+        )
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -858,6 +903,220 @@ class Router:
                 self._archive_errors += 1
             self._archive_ns += time.perf_counter_ns() - t0
 
+    # -- live weight updates (rolling deploy + SLO-burn rollback) -----------
+
+    def rolling_update(self, params=None, *, payload: Optional[bytes] = None,
+                       version: Optional[int] = None, drain: bool = True,
+                       retry_timeout_s: float = 60.0,
+                       guard_window_s: Optional[float] = None,
+                       monitor=None, _rollback: bool = False) -> dict:
+        """Push one weight set across the whole fleet, one replica at
+        a time: drain (stop routing new requests at it) → push the
+        chunked payload → undrain, so at every instant at least N-1
+        replicas stay routable and in-flight streams are never
+        touched (a pushed replica's engine swaps at its own tick
+        boundary; mid-stream requests continue uninterrupted).
+
+        ``params`` is the variables dict (serialized here);
+        ``payload`` passes already-serialized bytes (the wire arm and
+        the rollback re-push use this). A replica that dies mid-push
+        is retried through the manager's existing exponential-backoff
+        reconnect machinery until ``retry_timeout_s`` — the update
+        converges when it reconnects; replicas still unreachable at
+        the deadline are reported in ``failed`` (and the fleet is
+        version-skewed until a later push). A *validation* refusal
+        (typed :class:`~distkeras_tpu.serving.WeightPushError`) is
+        fleet-fatal and re-raised immediately: the same payload would
+        be refused everywhere, and a partly-updated fleet of
+        *accepted* weights is recoverable while a half-pushed refusal
+        is just noise.
+
+        ``guard_window_s`` (default: the constructor's
+        ``rollback_guard_window_s``) arms the SLO-burn auto-rollback
+        after a fully-converged update: a guard thread polls the
+        fleet's alerts (``monitor.alerts()`` when given, else every
+        replica's SLO monitor via ``manager.aggregate_alerts``) for
+        the window, and the first firing rule re-pushes the previous
+        version (``router_weight_rollbacks_total``). Serialized: one
+        rolling update at a time.
+
+        Returns ``{"version", "updated", "failed", "events",
+        "swap_ms", "rollback_armed"}`` — ``events`` carries one
+        ``{replica, drain_t, pushed_t, undrain_t, swap_ms}`` record
+        per successful push, in order (the rolling-update ordering
+        tests assert the intervals never overlap)."""
+        if payload is None:
+            from distkeras_tpu.serving.weights import serialize_weights
+
+            payload = serialize_weights(params)
+        with self._update_serial:
+            report = self._rolling_update_locked(
+                payload, version, drain, retry_timeout_s, _rollback)
+        window = (guard_window_s if guard_window_s is not None
+                  else self.rollback_guard_window_s)
+        armed = (window is not None and not _rollback
+                 and not report["failed"])
+        if armed:
+            self._arm_guard(report["version"], float(window),
+                            monitor or self.rollback_monitor)
+        report["rollback_armed"] = bool(armed)
+        return report
+
+    def _rolling_update_locked(self, payload: bytes,
+                               version: Optional[int], drain: bool,
+                               retry_timeout_s: float,
+                               is_rollback: bool) -> dict:
+        w = self._weights
+        version = (int(version) if version is not None
+                   and int(version) > w["version"]
+                   else w["version"] + 1)
+        t0 = time.perf_counter()
+        names = [r.name for r in self.manager.replicas]
+        pending = list(names)
+        updated: List[str] = []
+        events: List[dict] = []
+        swap_ms = 0.0
+        deadline = time.monotonic() + retry_timeout_s
+        while pending and time.monotonic() < deadline:
+            name = pending.pop(0)
+            replica = self.manager.get(name)
+            client = replica.client
+            # never reduce the routable set below N-1: taking this
+            # replica out is only allowed while every OTHER replica
+            # is routable (a concurrently-dead peer pauses the
+            # rollout instead of stacking outages)
+            others = [r for r in self.manager.routable()
+                      if r.name != name]
+            if client is None or replica.state == DOWN \
+                    or len(others) < len(names) - 1:
+                pending.append(name)
+                time.sleep(self.manager.poll_interval)
+                continue
+            ev: dict = {"replica": name}
+            drained_here = False
+            try:
+                if drain:
+                    ev["drain_t"] = time.monotonic()
+                    client.drain()
+                    replica.state = DRAINING
+                    self.manager.note_drain(replica)
+                    drained_here = True
+                res = client.push_weights(payload=payload,
+                                          version=version)
+                ev["pushed_t"] = time.monotonic()
+                ev["swap_ms"] = res.get("swap_ms")
+                swap_ms = max(swap_ms, float(res.get("swap_ms") or 0.0))
+                if drain:
+                    client.undrain()
+                    replica.state = HEALTHY
+                    ev["undrain_t"] = time.monotonic()
+            except WeightPushError:
+                # fleet-fatal: the payload itself is bad — reopen the
+                # replica and surface the typed refusal untouched
+                if drained_here:
+                    try:
+                        client.undrain()
+                        replica.state = HEALTHY
+                    except Exception:
+                        pass
+                self._m_weight_updates.labels(outcome="refused").inc()
+                raise
+            except (ServingConnectionError, TimeoutError,
+                    ConnectionError, OSError):
+                # died mid-push: down it now; the probe loop's backoff
+                # reconnect brings it back and this loop retries — the
+                # update converges when the replica does
+                self.manager.note_failure(replica)
+                pending.append(name)
+                continue
+            updated.append(name)
+            events.append(ev)
+        outcome = ("rollback" if is_rollback
+                   else ("partial" if pending else "ok"))
+        self._m_weight_updates.labels(outcome=outcome).inc()
+        if not pending:
+            self._weights = {
+                **w, "version": version,
+                "prev": (w["current"] if not is_rollback
+                         else w["prev"]),
+                "current": (version, payload),
+                "updates": w["updates"] + 1,
+                "last": outcome,
+            }
+        else:
+            self._weights = {**w, "last": outcome}
+        total_ms = (time.perf_counter() - t0) * 1e3
+        self.tracer.record(
+            0, "router.rolling_update", time.monotonic(), 0.0,
+            version=version, updated=len(updated),
+            failed=len(pending), rollback=int(is_rollback),
+            total_ms=round(total_ms, 3),
+        )
+        return {"version": version, "updated": updated,
+                "failed": pending, "events": events,
+                "swap_ms": round(swap_ms, 3),
+                "total_ms": round(total_ms, 3)}
+
+    def _arm_guard(self, version: int, window_s: float, monitor):
+        """Watch the fleet's SLO alerts for ``window_s`` after update
+        ``version``; the first firing rule triggers the rollback.
+        One daemon thread per armed update; a newer update (or
+        rollback) supersedes the watch."""
+        deadline = time.monotonic() + window_s
+        self._weights = {**self._weights, "guard_deadline": deadline}
+
+        def guard():
+            while (not self._stop.is_set()
+                   and time.monotonic() < deadline):
+                if self._weights["version"] != version:
+                    return  # superseded by a newer update
+                try:
+                    alerts = (monitor.alerts() if monitor is not None
+                              else self.manager.aggregate_alerts())
+                except Exception:
+                    alerts = []
+                firing = [a.get("rule") for a in alerts
+                          if a.get("firing")]
+                if firing:
+                    self._auto_rollback(version, firing)
+                    return
+                time.sleep(self.manager.poll_interval)
+
+        t = threading.Thread(target=guard, daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def _auto_rollback(self, burned_version: int, rules: List):
+        """The guard fired inside the window: re-push the previous
+        weight version fleet-wide (no guard on the re-push — rolling
+        back a rollback is an operator decision, not an automatic
+        one). Without a recorded previous version (the burn hit the
+        first ever update) the rollback is recorded as unavailable
+        and the fleet keeps the burned weights — alerting is already
+        firing, and guessing at weights would be worse."""
+        prev = self._weights["prev"]
+        self._m_weight_rollbacks.inc()
+        self.tracer.record(
+            0, "router.rollback", time.monotonic(), 0.0,
+            version=burned_version,
+            rules=",".join(str(r) for r in rules),
+            available=int(prev is not None),
+        )
+        if prev is None:
+            self._weights = {**self._weights,
+                             "rollbacks":
+                                 self._weights["rollbacks"] + 1,
+                             "last": "rollback_unavailable"}
+            return
+        self._weights = {**self._weights,
+                         "rollbacks": self._weights["rollbacks"] + 1}
+        try:
+            self.rolling_update(payload=prev[1], guard_window_s=None,
+                                _rollback=True)
+        except Exception:
+            self._weights = {**self._weights,
+                             "last": "rollback_failed"}
+
     # -- front-door protocol ------------------------------------------------
 
     def _accept_loop(self):
@@ -878,6 +1137,9 @@ class Router:
     def _handle(self, conn: socket.socket):
         lock = threading.Lock()
         pumps: List[threading.Thread] = []
+        # push_weights chunk reassembly, per connection (same
+        # discipline as LMServer's)
+        push_buf: dict = {}
         try:
             while not self._stop.is_set():
                 try:
@@ -932,6 +1194,14 @@ class Router:
                         })
                     elif op == "drain":
                         self._op_drain(conn, lock, msg)
+                    elif op == "push_weights":
+                        # the fleet half of live weight updates: the
+                        # reassembled payload rolls across every
+                        # replica (drain → push → undrain, one at a
+                        # time), and the final ack arrives only after
+                        # the fleet converged
+                        self._op_push_weights(conn, lock, msg,
+                                              push_buf)
                     elif op == "flight":
                         self._send(conn, lock, {
                             "ok": 0,
@@ -1032,7 +1302,14 @@ class Router:
 
     def _op_drain(self, conn, lock, msg: dict):
         name = msg.get("replica")
+        undrain = bool(msg.get("undrain"))
         if name is None:
+            if undrain:
+                # reopen ROUTER admissions (rolling-deploy symmetry)
+                self.draining = False
+                self._send(conn, lock, {"ok": 1, "draining": 0,
+                                        "active": 0, "queued": 0})
+                return
             # drain the ROUTER: no new admissions; in-flight streams
             # finish; stats reports drained once the table empties
             self.draining = True
@@ -1048,6 +1325,12 @@ class Router:
                 "ok": 0, "error": f"replica {name!r} is not connected",
             })
             return
+        if undrain:
+            reply = client.undrain()
+            replica.state = HEALTHY  # routable again immediately
+            self._send(conn, lock, {"ok": 1, "draining": 0,
+                                    "replica": replica.name, **reply})
+            return
         reply = client.drain()
         replica.state = DRAINING  # stop routing now, not at next poll
         # forget its affinity placements now too — the probe loop only
@@ -1056,6 +1339,59 @@ class Router:
         self.manager.note_drain(replica)
         self._send(conn, lock, {"ok": 1, "draining": 1,
                                 "replica": replica.name, **reply})
+
+    def _op_push_weights(self, conn, lock, msg: dict, buf: dict):
+        """One push_weights chunk at the fleet level: reassembly is
+        identical to LMServer's; the final chunk triggers
+        :meth:`rolling_update` with the raw payload (the router never
+        deserializes weights — validation is each replica's job), and
+        the ack carries the fleet outcome. A typed refusal from any
+        replica (bad payload) or an incomplete rollout answers the
+        ``weight_push`` error code."""
+        seq = int(msg["seq"])
+        n = int(msg["n"])
+        if seq == 0:
+            buf.clear()
+            buf["chunks"] = []
+        chunks = buf.get("chunks")
+        if chunks is None or len(chunks) != seq or seq >= n:
+            have = len(chunks) if chunks is not None else None
+            buf.clear()
+            self._send(conn, lock, {
+                "ok": 0, "error": "weight_push",
+                "detail": f"out-of-order push chunk seq={seq} of "
+                          f"n={n} (have {have})",
+            })
+            return
+        chunks.append(bytes(msg["chunk"]))
+        if seq < n - 1:
+            self._send(conn, lock, {"ok": 1, "received": seq})
+            return
+        payload = b"".join(chunks)
+        buf.clear()
+        version = (None if msg.get("version") is None
+                   else int(msg["version"]))
+        try:
+            report = self.rolling_update(payload=payload,
+                                         version=version)
+        except WeightPushError as e:
+            self._send(conn, lock, {
+                "ok": 0, "error": "weight_push", "detail": str(e),
+            })
+            return
+        if report["failed"]:
+            self._send(conn, lock, {
+                "ok": 0, "error": "weight_push",
+                "detail": f"rolling update incomplete: "
+                          f"updated={report['updated']} "
+                          f"failed={report['failed']}",
+            })
+            return
+        self._send(conn, lock, {
+            "ok": 1, "applied": 1, "version": report["version"],
+            "swap_ms": report["swap_ms"],
+            "updated": report["updated"],
+        })
 
     # -- aggregated views ---------------------------------------------------
 
@@ -1121,6 +1457,19 @@ class Router:
                         99, phase="router"),
                 },
             },
+        }
+        # live weight updates: one atomic snapshot of the rolling-
+        # update state (the dict is rebound, never mutated)
+        wsnap = self._weights
+        router["weights"] = {
+            "version": wsnap["version"],
+            "updates": wsnap["updates"],
+            "rollbacks": wsnap["rollbacks"],
+            "rollback_available": wsnap["prev"] is not None,
+            "guard_active": (
+                wsnap["guard_deadline"] is not None
+                and time.monotonic() < wsnap["guard_deadline"]),
+            "last_outcome": wsnap["last"],
         }
         with self._archive_lock:
             archived = self._archived

@@ -20,7 +20,17 @@ Protocol (all frames are msgpack dicts):
                                               # trace-event JSON
     {"op": "flight", "last"?: n}              # flight-recorder ticks
     {"op": "alerts"}                          # SLO monitor state
-    {"op": "drain"}                           # close admissions (graceful)
+    {"op": "drain"}                           # close admissions (graceful);
+                                              # with "undrain": 1 reopen
+                                              # them (rolling updates)
+    {"op": "push_weights", "seq": i, "n": k, "chunk": bytes,
+     "version"?: v}                           # live weight update: one
+                                              # serialized variables
+                                              # blob chunked across k
+                                              # frames; the last chunk
+                                              # validates + atomically
+                                              # swaps at the tick
+                                              # boundary
     {"op": "export_kv", "prompt": [ids]}      # gather the cached KV
                                               # blocks covering the
                                               # prompt's prefix, for
@@ -46,6 +56,12 @@ Protocol (all frames are msgpack dicts):
                                               # terminal dispatch arm, so
                                               # the handled op set is
                                               # closed and checkable)
+    {"ok": 0, "error": "weight_push", "detail": msg}
+                                              # pushed weights refused
+                                              # before any swap (typed:
+                                              # WeightPushError naming
+                                              # the first mismatched
+                                              # leaf)
     {"id": rid, "t": tok}                     # one streamed token
     {"id": rid, "done": 1, "reason": r, "n": k}   # stream end
     {"ok": 1, "stats": {...}}                 # stats reply
@@ -55,6 +71,10 @@ Protocol (all frames are msgpack dicts):
     {"ok": 1, "flight": {"meta":..,"ticks":[..]}}   # FlightRecorder ring
     {"ok": 1, "alerts": [...]}                # SloMonitor.alerts()
     {"ok": 1, "draining": 1, "active": a, "queued": q}   # drain accepted
+    {"ok": 1, "received": i}                  # push_weights chunk i < k-1
+    {"ok": 1, "applied": 1, "version": v, "swap_ms": ms}
+                                              # push_weights final chunk:
+                                              # the swap is live
     {"ok": 1, "tokens": t, "blocks": [...]}   # export_kv reply (tokens
                                               # 0 = nothing cached —
                                               # the caller falls back
@@ -83,11 +103,17 @@ from __future__ import annotations
 import queue as _queue
 import socket
 import threading
-from typing import Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 from distkeras_tpu.networking import connect, recv_msg, send_msg
 from distkeras_tpu.serving.engine import ServingEngine
 from distkeras_tpu.serving.scheduler import DrainingError, QueueFullError
+from distkeras_tpu.serving.weights import (
+    WeightPushError,
+    chunk_payload,
+    deserialize_weights,
+    serialize_weights,
+)
 from distkeras_tpu.telemetry.chrome import to_chrome_trace
 
 # serving frames are small (one token or one prompt); cap accordingly
@@ -290,6 +316,9 @@ class LMServer:
     def _handle(self, conn: socket.socket):
         lock = threading.Lock()
         pumps: List[threading.Thread] = []
+        # push_weights chunk reassembly, per connection (chunks of one
+        # push always ride one connection, in order)
+        push_buf: dict = {}
         try:
             while not self._stop.is_set():
                 try:
@@ -408,16 +437,37 @@ class LMServer:
                             "mode": out["mode"],
                         })
                     elif op == "drain":
-                        # graceful drain: admissions close now; queued +
-                        # in-flight streams finish under the normal loop
-                        # (stats reports draining/drained progress)
-                        self.engine.begin_drain()
-                        st = self.engine.stats()
-                        self._send(conn, lock, {
-                            "ok": 1, "draining": 1,
-                            "active": st["active_slots"],
-                            "queued": st["queue_depth"],
-                        })
+                        if msg.get("undrain"):
+                            # reopen admissions: the undrain half of
+                            # the rolling-update primitive
+                            self.engine.end_drain()
+                            st = self.engine.stats()
+                            self._send(conn, lock, {
+                                "ok": 1, "draining": 0,
+                                "active": st["active_slots"],
+                                "queued": st["queue_depth"],
+                            })
+                        else:
+                            # graceful drain: admissions close now;
+                            # queued + in-flight streams finish under
+                            # the normal loop (stats reports
+                            # draining/drained progress)
+                            self.engine.begin_drain()
+                            st = self.engine.stats()
+                            self._send(conn, lock, {
+                                "ok": 1, "draining": 1,
+                                "active": st["active_slots"],
+                                "queued": st["queue_depth"],
+                            })
+                    elif op == "push_weights":
+                        # live weight update: chunks accumulate per
+                        # connection; the last one deserializes,
+                        # validates against the live tree, and swaps
+                        # atomically at the tick boundary (marshalled
+                        # onto the engine loop thread — no locks touch
+                        # the hot path)
+                        self._op_push_weights(conn, lock, msg,
+                                              push_buf)
                     else:
                         # typed terminal arm: the handled op set above
                         # is CLOSED — the wire-contract pass extracts
@@ -451,6 +501,60 @@ class LMServer:
             with self._conns_lock:
                 if conn in self._conns:
                     self._conns.remove(conn)
+
+    def _op_push_weights(self, conn, lock, msg: dict, buf: dict):
+        """One push_weights chunk. ``buf`` is the per-connection
+        reassembly state: chunk 0 resets it, the last chunk joins,
+        deserializes, and applies the swap on the engine loop thread.
+        Refusals — out-of-order chunks, an undecodable payload, or a
+        tree that fails validation against the live weights — answer
+        the typed ``weight_push`` error code with the detail (the
+        first mismatched leaf) in ``detail``; nothing is swapped."""
+        seq = int(msg["seq"])
+        n = int(msg["n"])
+        if seq == 0:
+            buf.clear()
+            buf["chunks"] = []
+        chunks = buf.get("chunks")
+        if chunks is None or len(chunks) != seq or seq >= n:
+            have = len(chunks) if chunks is not None else None
+            buf.clear()
+            self._send(conn, lock, {
+                "ok": 0, "error": "weight_push",
+                "detail": f"out-of-order push chunk seq={seq} of "
+                          f"n={n} (have {have})",
+            })
+            return
+        chunks.append(bytes(msg["chunk"]))
+        if seq < n - 1:
+            self._send(conn, lock, {"ok": 1, "received": seq})
+            return
+        payload = b"".join(chunks)
+        buf.clear()
+        version = (None if msg.get("version") is None
+                   else int(msg["version"]))
+        try:
+            variables = deserialize_weights(payload)
+        except Exception as e:
+            self._send(conn, lock, {
+                "ok": 0, "error": "weight_push",
+                "detail": f"undecodable weight payload "
+                          f"({type(e).__name__}: {e})",
+            })
+            return
+        try:
+            out = self.engine.call_in_loop(
+                lambda: self.engine.update_weights(variables,
+                                                   version=version))
+        except WeightPushError as e:
+            self._send(conn, lock, {
+                "ok": 0, "error": "weight_push", "detail": str(e),
+            })
+            return
+        self._send(conn, lock, {
+            "ok": 1, "applied": 1, "version": out["version"],
+            "swap_ms": out["swap_ms"],
+        })
 
 
 class ServingClient:
@@ -614,6 +718,10 @@ class ServingClient:
                     f"handle op {bad!r}",
                     op=bad,
                 )
+            if err == "weight_push":
+                raise WeightPushError(
+                    str(reply.get("detail")
+                        or "weight push refused"))
             raise RuntimeError(err)
         return reply
 
@@ -767,6 +875,44 @@ class ServingClient:
                 "tokens": int(reply["tokens"]),
                 "mode": str(reply["mode"])}
 
+    def push_weights(self, variables: Any = None, *,
+                     payload: Optional[bytes] = None,
+                     version: Optional[int] = None,
+                     chunk_bytes: int = 4 << 20,
+                     timeout: Optional[float] = None) -> dict:
+        """Push a live weight update: serialize ``variables`` (the
+        model's ``{"params": ...}`` dict; ``payload`` passes
+        already-serialized bytes instead, the router's re-push path),
+        chunk the blob across framed messages, and stream the chunks
+        up one connection. The server validates structure/shape/dtype
+        against its live tree and swaps atomically at the tick
+        boundary; in-flight ticks complete on the old version, and no
+        stream is dropped or corrupted by a mid-stream push.
+
+        Against a :class:`~distkeras_tpu.serving.Router` the same op
+        is a fleet-wide **rolling update** (drain → push → undrain,
+        one replica at a time); the ack then arrives after the whole
+        fleet converged — pass a generous ``timeout``.
+
+        Raises the typed
+        :class:`~distkeras_tpu.serving.WeightPushError` (naming the
+        first mismatched leaf) when the server refuses the tree;
+        nothing was swapped in that case. Returns ``{"version",
+        "swap_ms"}`` of the applied update."""
+        if payload is None:
+            payload = serialize_weights(variables)
+        chunks = chunk_payload(payload, chunk_bytes)
+        n = len(chunks)
+        reply: dict = {}
+        for i, ch in enumerate(chunks):
+            msg: dict = {"op": "push_weights", "seq": i, "n": n,
+                         "chunk": ch}
+            if version is not None:
+                msg["version"] = int(version)
+            reply = self._call(msg, timeout=timeout)
+        return {"version": int(reply["version"]),
+                "swap_ms": reply.get("swap_ms")}
+
     def drain(self, replica: Optional[str] = None) -> dict:
         """Gracefully drain the server: admissions close immediately
         (subsequent :meth:`generate` calls raise
@@ -780,6 +926,17 @@ class ServingClient:
         rolling-deploy primitive) while the router keeps admitting. A
         direct LMServer ignores the field and drains itself."""
         msg: dict = {"op": "drain"}
+        if replica is not None:
+            msg["replica"] = str(replica)
+        reply = self._call(msg)
+        return {"active": int(reply.get("active", 0)),
+                "queued": int(reply.get("queued", 0))}
+
+    def undrain(self, replica: Optional[str] = None) -> dict:
+        """Reopen admissions on a drained server (or, through a
+        router, on one named backend replica) — the undrain half of
+        the rolling-update primitive. Idempotent."""
+        msg: dict = {"op": "drain", "undrain": 1}
         if replica is not None:
             msg["replica"] = str(replica)
         reply = self._call(msg)

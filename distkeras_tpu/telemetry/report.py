@@ -257,6 +257,10 @@ def report_flight(path: str, last: Optional[int] = None,
         return
     shown = ticks if last is None else ticks[-last:]
     base_t = shown[0].get("t", 0.0)
+    # the w=vN column only appears once a live weight update actually
+    # happened (every tick at the construction version is just noise)
+    show_wv = any(r.get("weight_version") not in (None, 1)
+                  for r in ticks)
     out.write(
         f"  {'tick':>7} {'t+s':>8} {'occ':>5} {'q':>3} "
         f"{'dec':>4} {'pre':>4} {'plan':>7} {'device':>8} "
@@ -276,6 +280,10 @@ def report_flight(path: str, last: Optional[int] = None,
         if "blocks" in r:
             b = r["blocks"]
             extra += f"  blocks={b.get('in_use')}/{b.get('free')}free"
+        if show_wv and "weight_version" in r:
+            # live weight updates: which weight set served this tick
+            # (a hot swap is the version stepping between rows)
+            extra += f"  w=v{r['weight_version']}"
         if "demoted" in r and (r.get("demoted") or r.get("restored")):
             # tiered KV cache: blocks swapped out/in this tick
             extra += f"  tier=-{r['demoted']}/+{r.get('restored', 0)}"
@@ -341,6 +349,15 @@ def report_flight(path: str, last: Optional[int] = None,
         out.write(
             f"host tier: {demoted} blocks demoted, {restored} "
             f"restored, {host_now} resident at last tick\n"
+        )
+    versions = [r["weight_version"] for r in ticks
+                if "weight_version" in r]
+    if versions and show_wv:
+        swaps = sum(1 for a, b in zip(versions, versions[1:])
+                    if b != a)
+        out.write(
+            f"weights: v{versions[0]} -> v{versions[-1]}, "
+            f"{swaps} swap(s) inside the retained window\n"
         )
     if any("kv_exported" in r or "kv_imported" in r for r in ticks):
         # disaggregated serving: migration traffic through this

@@ -1,0 +1,769 @@
+"""Live weight updates: atomic hot swap, rolling updates, rollback.
+
+The invariants under test (ARCHITECTURE.md · "Live weight updates"):
+
+- the swap lands at the tick boundary — a request submitted before a
+  swap but not yet ticked streams entirely on the NEW weights, a
+  request fully served before the swap is bit-identical to solo
+  ``generate()`` on the OLD weights, and a mid-stream push neither
+  corrupts nor drops the stream;
+- a pushed tree that does not match the live one (structure, shape,
+  dtype) is refused with a typed :class:`WeightPushError` naming the
+  first mismatched leaf, before anything is touched — engine-level,
+  over the wire, and through the router;
+- ``Router.rolling_update`` takes replicas out one at a time (never
+  below N-1 routable), converges through the backoff machinery when a
+  replica dies mid-push, and the SLO-burn guard re-pushes the previous
+  version (``router_weight_rollbacks_total``) with zero lost streams;
+- the fault-injection seam in :mod:`distkeras_tpu.networking` /
+  :mod:`distkeras_tpu.serving.fleet` is deterministic and seeded.
+"""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distkeras_tpu import telemetry
+from distkeras_tpu.models import get_model
+from distkeras_tpu.models.transformer import generate
+from distkeras_tpu.networking import (
+    FaultInjector,
+    install_fault_injector,
+    uninstall_fault_injector,
+)
+from distkeras_tpu.serving import (
+    CheckpointWatcher,
+    LMServer,
+    ParameterServerFeed,
+    Router,
+    ServingClient,
+    ServingEngine,
+    WeightPushError,
+)
+from distkeras_tpu.serving.fleet import DOWN, Replica, ReplicaManager
+
+V, D, H, L, MAXLEN = 64, 32, 2, 2, 160
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    model = get_model(
+        "transformer_lm", vocab_size=V, d_model=D, num_heads=H,
+        num_layers=L, max_len=MAXLEN, attention="dense",
+    )
+    pa = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32))
+    pb = model.init(jax.random.PRNGKey(1), jnp.zeros((1, 4), jnp.int32))
+    return model, pa, pb
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_faults():
+    yield
+    uninstall_fault_injector()
+
+
+def _engine(model, params, **kw):
+    kw.setdefault("slots", 2)
+    kw.setdefault("registry", telemetry.MetricRegistry())
+    kw.setdefault("tracer", telemetry.Tracer())
+    return ServingEngine(model, params, **kw)
+
+
+def _ref(model, params, prompt, n):
+    return np.asarray(
+        generate(model, params, jnp.asarray(prompt)[None], n)
+    )[0, len(prompt):].tolist()
+
+
+PROMPT = np.arange(1, 9, dtype=np.int32)
+
+
+# -- engine-level swap semantics ---------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "mode",
+    ["paged", "pipelined",
+     # the slot and spec legs trace their own tick families: multichip-
+     # job material, not tier-1 (the CPU tier-1 wall clock is tight;
+     # paged + pipelined already pin the tick-boundary semantics there)
+     pytest.param("slot", marks=pytest.mark.slow),
+     pytest.param("spec", marks=pytest.mark.slow)],
+)
+def test_swap_boundary_parity(model_and_params, mode):
+    """The documented swap-boundary invariant, across engine modes: a
+    request finished pre-swap is generate(old), one submitted pre-swap
+    but ticked post-swap is generate(new), and a MID-stream push
+    neither corrupts nor drops the stream (later requests are
+    generate(new))."""
+    model, pa, pb = model_and_params
+    kw = {}
+    if mode == "paged":
+        kw = dict(paged=True, block_size=16)
+    elif mode == "pipelined":
+        kw = dict(paged=True, block_size=16, pipeline=True)
+    elif mode == "spec":
+        kw = dict(draft="ngram", spec_k=2)
+    eng = _engine(model, pa, **kw)
+    # fully served on the old version
+    r0 = eng.submit(PROMPT, max_new_tokens=8)
+    eng.drain()
+    assert r0.stream.tokens() == _ref(model, pa, PROMPT, 8)
+    # submitted before the swap, ticked entirely after it: the tick
+    # boundary is the swap point, so this stream is pure new-version
+    r1 = eng.submit(PROMPT, max_new_tokens=8)
+    eng.update_weights(pb)
+    eng.drain()
+    assert r1.stream.tokens() == _ref(model, pb, PROMPT, 8)
+    # mid-stream push: run a long request a few ticks, swap, finish —
+    # the stream must complete with its full token budget
+    r2 = eng.submit(PROMPT, max_new_tokens=24)
+    for _ in range(6):
+        eng.step()
+    eng.update_weights(pa)
+    eng.drain()
+    toks = r2.stream.tokens()
+    assert len(toks) == 24 and r2.stream.finish_reason == "length"
+    # and the engine now serves the re-pushed version exactly
+    r3 = eng.submit(PROMPT, max_new_tokens=8)
+    eng.drain()
+    assert r3.stream.tokens() == _ref(model, pa, PROMPT, 8)
+    assert eng.weight_version == 3
+    assert eng.weight_swaps == 2
+
+
+def test_swap_version_monotonic_and_telemetry(model_and_params):
+    model, pa, pb = model_and_params
+    reg = telemetry.MetricRegistry()
+    tr = telemetry.Tracer()
+    eng = _engine(model, pa, registry=reg, tracer=tr)
+    assert eng.weight_version == 1
+    out = eng.update_weights(pb, version=10)
+    assert out["version"] == 10 and eng.weight_version == 10
+    # a stale explicit version still bumps (monotonic, observable)
+    out = eng.update_weights(pa, version=4)
+    assert out["version"] == 11
+    out = eng.update_weights(pb)
+    assert out["version"] == 12
+    assert eng.weight_swaps == 3
+    assert reg.gauge("serving_weight_version").value == 12
+    assert reg.counter("serving_weight_swaps_total").value == 3
+    snap = reg.get("serving_weight_swap_ms").snapshot()
+    assert snap["series"][0]["count"] == 3
+    # the version is stamped into spans and flight snapshots
+    r = eng.submit(PROMPT, max_new_tokens=4)
+    eng.drain()
+    r.stream.tokens()
+    spans = {s["span"]: s for s in tr.dump(trace=r.trace_id)}
+    assert spans["finish"]["wv"] == 12
+    assert spans["decode"]["wv"] == 12
+    ticks = eng.flight.snapshots()
+    assert ticks and all(t["weight_version"] == 12 for t in ticks)
+    assert eng.stats()["weight_version"] == 12
+    assert eng.stats()["weight_swaps"] == 3
+
+
+def test_validation_refusals_name_first_leaf(model_and_params):
+    model, pa, pb = model_and_params
+    eng = _engine(model, pa)
+
+    def mutate_first(params, fn):
+        leaves, treedef = jax.tree_util.tree_flatten(params)
+        leaves = list(leaves)
+        leaves[0] = fn(np.asarray(leaves[0]))
+        return jax.tree_util.tree_unflatten(treedef, leaves)
+
+    # wrong shape
+    bad = mutate_first(pb, lambda a: np.zeros(a.shape + (1,), a.dtype))
+    with pytest.raises(WeightPushError) as ei:
+        eng.update_weights(bad)
+    assert "shape" in str(ei.value) and ei.value.leaf
+    # wrong dtype
+    bad = mutate_first(pb, lambda a: a.astype(np.float64))
+    with pytest.raises(WeightPushError, match="dtype"):
+        eng.update_weights(bad)
+    # missing leaf / extra leaf (structure)
+    bad = {"params": {"nothing": np.zeros((2,), np.float32)}}
+    with pytest.raises(WeightPushError, match="missing leaf"):
+        eng.update_weights(bad)
+    extra = jax.tree.map(lambda x: x, pb)
+    extra["params"]["bonus"] = np.zeros((2,), np.float32)
+    with pytest.raises(WeightPushError, match="unknown leaf"):
+        eng.update_weights(extra)
+    del extra["params"]["bonus"]
+    # nothing was swapped by any refusal
+    assert eng.weight_version == 1 and eng.weight_swaps == 0
+    r = eng.submit(PROMPT, max_new_tokens=6)
+    eng.drain()
+    assert r.stream.tokens() == _ref(model, pa, PROMPT, 6)
+
+
+@pytest.mark.slow
+def test_swap_parity_tp4():
+    """Weight push under tensor parallelism: the new tree re-shards
+    onto the mesh (reshard-on-upload) and streams stay bit-identical
+    to single-chip generate() on the pushed weights."""
+    from distkeras_tpu.parallel import make_mesh
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 devices (forced host devices in CI)")
+    # heads must divide the mesh: a 4-head twin of the module model
+    model = get_model(
+        "transformer_lm", vocab_size=V, d_model=D, num_heads=4,
+        num_layers=L, max_len=MAXLEN, attention="dense",
+    )
+    pa = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32))
+    pb = model.init(jax.random.PRNGKey(1), jnp.zeros((1, 4), jnp.int32))
+    eng = _engine(model, pa, mesh=make_mesh({"model": 4}), paged=True,
+                  block_size=16)
+    r0 = eng.submit(PROMPT, max_new_tokens=6)
+    eng.drain()
+    assert r0.stream.tokens() == _ref(model, pa, PROMPT, 6)
+    eng.update_weights(pb, version=2)
+    r1 = eng.submit(PROMPT, max_new_tokens=6)
+    eng.drain()
+    assert r1.stream.tokens() == _ref(model, pb, PROMPT, 6)
+
+
+# -- wire level ---------------------------------------------------------------
+
+
+def test_push_weights_wire_roundtrip_and_refusal(model_and_params):
+    model, pa, pb = model_and_params
+    eng = _engine(model, pa, paged=True, block_size=16)
+    srv = LMServer(eng).start()
+    try:
+        c = ServingClient("127.0.0.1", srv.port)
+        # tiny chunks exercise the reassembly path
+        out = c.push_weights(pb, version=3, chunk_bytes=2048)
+        assert out["version"] == 3 and out["swap_ms"] is not None
+        rid = c.generate(PROMPT, max_new_tokens=6)
+        toks, reason = c.result(rid)
+        assert toks == _ref(model, pb, PROMPT, 6)
+        # typed refusal over the wire names the leaf; nothing swapped
+        bad = jax.tree.map(
+            lambda a: np.zeros(np.shape(a) + (1,), np.asarray(a).dtype),
+            pb)
+        with pytest.raises(WeightPushError, match="shape"):
+            c.push_weights(bad, chunk_bytes=2048)
+        assert c.stats()["weight_version"] == 3
+        # out-of-order chunk is refused typed too (fresh state after)
+        with pytest.raises(WeightPushError, match="out-of-order"):
+            c._call({"op": "push_weights", "seq": 1, "n": 2,
+                     "chunk": b"xx"})
+        c.close()
+    finally:
+        srv.stop()
+
+
+@pytest.mark.slow
+def test_midstream_wire_pushes_drop_nothing(model_and_params):
+    """Pushes arriving while streams are in flight: every stream
+    completes with its full token budget, none disconnects."""
+    model, pa, pb = model_and_params
+    eng = _engine(model, pa, paged=True, block_size=16, slots=3)
+    srv = LMServer(eng).start()
+    try:
+        c = ServingClient("127.0.0.1", srv.port, request_timeout=120.0)
+        rids = [c.generate(PROMPT, max_new_tokens=32, seed=i)
+                for i in range(6)]
+        pusher = ServingClient("127.0.0.1", srv.port,
+                               request_timeout=120.0)
+        for params in (pb, pa, pb):
+            pusher.push_weights(params, chunk_bytes=4096)
+        results = [c.result(rid, timeout=120) for rid in rids]
+        assert all(reason == "length" and len(toks) == 32
+                   for toks, reason in results), results
+        assert c.stats()["weight_swaps"] == 3
+        pusher.close()
+        c.close()
+    finally:
+        srv.stop()
+
+
+def test_undrain_roundtrip(model_and_params):
+    model, pa, _ = model_and_params
+    eng = _engine(model, pa)
+    srv = LMServer(eng).start()
+    try:
+        c = ServingClient("127.0.0.1", srv.port)
+        c.drain()
+        assert c.stats()["draining"]
+        c.undrain()
+        assert not c.stats()["draining"]
+        rid = c.generate(PROMPT, max_new_tokens=2)
+        toks, reason = c.result(rid)
+        assert reason == "length" and len(toks) == 2
+        c.close()
+    finally:
+        srv.stop()
+
+
+# -- router: rolling updates, chaos, rollback --------------------------------
+
+
+def _fleet(model, params, n=3, **router_kw):
+    servers = []
+    for i in range(n):
+        eng = ServingEngine(
+            model, params, slots=2, paged=True, block_size=16,
+            registry=telemetry.MetricRegistry(),
+            tracer=telemetry.Tracer(pid=2000 + i),
+        )
+        servers.append(LMServer(eng).start())
+    router_kw.setdefault("poll_interval", 0.05)
+    router_kw.setdefault("down_after", 1)
+    router_kw.setdefault("backoff_base", 0.05)
+    router = Router(
+        [("127.0.0.1", s.port, f"r{i}") for i, s in enumerate(servers)],
+        registry=telemetry.MetricRegistry(),
+        tracer=telemetry.Tracer(pid=1),
+        **router_kw,
+    ).start()
+    return servers, router
+
+
+def _stop_fleet(servers, router, clients=()):
+    for c in clients:
+        c.close()
+    router.stop()
+    for s in servers:
+        try:
+            s.stop()
+        except Exception:
+            pass
+
+
+def test_rolling_update_one_at_a_time_never_below_n_minus_1(
+        model_and_params):
+    model, pa, pb = model_and_params
+    servers, router = _fleet(model, pa)
+    try:
+        min_routable = [len(router.manager.routable())]
+        stop = threading.Event()
+
+        def watch():
+            while not stop.is_set():
+                min_routable[0] = min(min_routable[0],
+                                      len(router.manager.routable()))
+                time.sleep(0.005)
+
+        t = threading.Thread(target=watch, daemon=True)
+        t.start()
+        report = router.rolling_update(pb, version=2)
+        stop.set()
+        t.join(timeout=5)
+        assert report["failed"] == [] and len(report["updated"]) == 3
+        # one at a time: each replica's undrain precedes the next drain
+        evs = report["events"]
+        assert [e["replica"] for e in evs] == report["updated"]
+        for a, b in zip(evs, evs[1:]):
+            assert a["undrain_t"] <= b["drain_t"]
+        # the routable set never dropped below N-1
+        assert min_routable[0] >= 2
+        # fleet converged: every replica serves the new version
+        for s in servers:
+            assert s.engine.weight_version == 2
+        c = ServingClient("127.0.0.1", router.port,
+                          request_timeout=120.0)
+        rid = c.generate(PROMPT, max_new_tokens=6)
+        toks, _ = c.result(rid)
+        assert toks == _ref(model, pb, PROMPT, 6)
+        st = c.stats()
+        assert st["router"]["weights"]["version"] == 2
+        assert st["router"]["weights"]["updates"] == 1
+        c.close()
+    finally:
+        _stop_fleet(servers, router)
+
+
+def test_rolling_update_converges_after_midpush_kill(model_and_params):
+    """Chaos: the transport seam kills a connection at the Nth push
+    chunk — the replica's client dies mid-push, the manager's backoff
+    machinery reconnects it, and the rolling update converges; streams
+    in flight throughout complete untouched."""
+    model, pa, pb = model_and_params
+    servers, router = _fleet(model, pa)
+    try:
+        c = ServingClient("127.0.0.1", router.port,
+                          request_timeout=120.0)
+        rids = [c.generate(PROMPT, max_new_tokens=24, seed=i)
+                for i in range(4)]
+        # weight chunks are the only frames this big; the 2nd one dies
+        fi = FaultInjector(seed=7)
+        rule = fi.rule("kill", direction="send", nth=2,
+                       min_bytes=8 << 10)
+        install_fault_injector(fi)
+        report = router.rolling_update(pb, version=2,
+                                       retry_timeout_s=60.0)
+        uninstall_fault_injector()
+        assert rule.fired == 1
+        assert report["failed"] == [], report
+        assert sorted(report["updated"]) == ["r0", "r1", "r2"]
+        for s in servers:
+            assert s.engine.weight_version == 2
+        # zero lost streams through the mid-push death
+        results = [c.result(rid, timeout=120) for rid in rids]
+        assert all(len(t) == 24 and r == "length" for t, r in results)
+        assert c.stats()["router"]["failed"] == 0
+        c.close()
+    finally:
+        uninstall_fault_injector()
+        _stop_fleet(servers, router)
+
+
+class _FakeMonitor:
+    """Deterministic SLO stand-in: fires when told to."""
+
+    def __init__(self):
+        self.firing = threading.Event()
+
+    def alerts(self):
+        return [{"rule": "fake_burn", "firing": self.firing.is_set()}]
+
+
+def test_auto_rollback_on_slo_burn(model_and_params):
+    model, pa, pb = model_and_params
+    servers, router = _fleet(model, pa)
+    try:
+        # establish a previous version the guard can roll back to
+        router.rolling_update(pa, version=2)
+        mon = _FakeMonitor()
+        report = router.rolling_update(pb, version=3,
+                                       guard_window_s=30.0,
+                                       monitor=mon)
+        assert report["rollback_armed"]
+        c = ServingClient("127.0.0.1", router.port,
+                          request_timeout=120.0)
+        rids = [c.generate(PROMPT, max_new_tokens=24, seed=i)
+                for i in range(3)]
+        mon.firing.set()  # the burn-rate rules fire inside the window
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            w = router.stats()["router"]["weights"]
+            if w["rollbacks"] >= 1 and w["last_outcome"] == "rollback":
+                break
+            time.sleep(0.05)
+        w = router.stats()["router"]["weights"]
+        assert w["rollbacks"] == 1, w
+        assert router.registry.counter(
+            "router_weight_rollbacks_total").value == 1
+        # the fleet is back on the previous weights (new version id)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and any(
+                s.engine.weight_version < 4 for s in servers):
+            time.sleep(0.05)
+        rid = c.generate(PROMPT, max_new_tokens=6)
+        toks, _ = c.result(rid)
+        assert toks == _ref(model, pa, PROMPT, 6)
+        # zero lost streams through the rollback
+        results = [c.result(rid, timeout=120) for rid in rids]
+        assert all(len(t) == 24 and r == "length" for t, r in results)
+        c.close()
+    finally:
+        _stop_fleet(servers, router)
+
+
+def test_rollback_without_history_is_recorded(model_and_params):
+    model, pa, pb = model_and_params
+    servers, router = _fleet(model, pa, n=2)
+    try:
+        mon = _FakeMonitor()
+        mon.firing.set()
+        router.rolling_update(pb, version=2, guard_window_s=10.0,
+                              monitor=mon)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            w = router.stats()["router"]["weights"]
+            if w["rollbacks"] >= 1:
+                break
+            time.sleep(0.05)
+        w = router.stats()["router"]["weights"]
+        assert w["rollbacks"] == 1
+        assert w["last_outcome"] == "rollback_unavailable"
+        # the fleet keeps the (only) pushed weights
+        assert all(s.engine.weight_version == 2 for s in servers)
+    finally:
+        _stop_fleet(servers, router)
+
+
+def test_bad_checkpoint_refused_through_router(model_and_params):
+    model, pa, _ = model_and_params
+    servers, router = _fleet(model, pa, n=2)
+    try:
+        c = ServingClient("127.0.0.1", router.port,
+                          request_timeout=120.0)
+        bad = {"params": {"garbage": np.zeros((3,), np.float32)}}
+        with pytest.raises(WeightPushError):
+            c.push_weights(bad, chunk_bytes=4096, timeout=120.0)
+        assert all(s.engine.weight_version == 1 for s in servers)
+        # replicas were reopened after the refusal: traffic still flows
+        rid = c.generate(PROMPT, max_new_tokens=4)
+        toks, reason = c.result(rid)
+        assert reason == "length" and len(toks) == 4
+        c.close()
+    finally:
+        _stop_fleet(servers, router)
+
+
+# -- feeders ------------------------------------------------------------------
+
+
+def test_checkpoint_watcher_pushes_new_steps(model_and_params,
+                                             tmp_path):
+    from distkeras_tpu.checkpoint import Checkpointer
+
+    model, pa, pb = model_and_params
+    eng = _engine(model, pa)
+    srv = LMServer(eng).start()
+    try:
+        c = ServingClient("127.0.0.1", srv.port, request_timeout=120.0)
+        ck = Checkpointer(str(tmp_path), every_steps=1)
+        ck.maybe_save(5, pb["params"])
+        ck.wait()
+        w = CheckpointWatcher(str(tmp_path), c)
+        assert w.poll_once()
+        assert not w.poll_once()  # same step: no re-push
+        assert eng.weight_version == 5
+        rid = c.generate(PROMPT, max_new_tokens=6)
+        toks, _ = c.result(rid)
+        assert toks == _ref(model, pb, PROMPT, 6)
+        ck.maybe_save(6, pa["params"])
+        ck.wait()
+        assert w.poll_once()
+        assert eng.weight_version == 6
+        # a bad checkpoint is refused, recorded, and does not kill
+        # the watcher (the next good step still pushes)
+        ck2 = Checkpointer(str(tmp_path / "bad"), every_steps=1)
+        ck2.maybe_save(1, {"nope": np.zeros((2,), np.float32)})
+        ck2.wait()
+        wbad = CheckpointWatcher(str(tmp_path / "bad"), c)
+        assert not wbad.poll_once()
+        assert wbad.errors and wbad.errors[0][0] == 1
+        assert eng.weight_version == 6
+        ck.close()
+        ck2.close()
+        w.stop()
+        wbad.stop()
+        c.close()
+    finally:
+        srv.stop()
+
+
+def test_parameter_server_feed_follows_commits(model_and_params):
+    from distkeras_tpu.parameter_servers import DeltaParameterServer
+
+    model, pa, _ = model_and_params
+    eng = _engine(model, pa)
+    srv = LMServer(eng).start()
+    try:
+        c = ServingClient("127.0.0.1", srv.port, request_timeout=120.0)
+        ps = DeltaParameterServer(pa)
+        feed = ParameterServerFeed(ps, c, min_updates=1)
+        assert not feed.poll_once()  # no commits yet
+        delta = jax.tree.map(lambda a: jnp.ones_like(a) * 0.01, pa)
+        ps.commit(delta)
+        assert feed.poll_once()
+        assert eng.weight_version == 1 + 0 or eng.weight_version >= 1
+        # the serving stream now matches the committed center exactly
+        center = jax.tree.map(np.asarray, ps.pull())
+        rid = c.generate(PROMPT, max_new_tokens=6)
+        toks, _ = c.result(rid)
+        assert toks == _ref(model, center, PROMPT, 6)
+        assert not feed.poll_once()  # no new commits: no re-push
+        ps.commit(delta)
+        assert feed.poll_once()
+        assert feed.pushed == 2
+        feed.stop()
+        c.close()
+    finally:
+        srv.stop()
+
+
+# -- fault-injection seam -----------------------------------------------------
+
+
+def _socket_pair():
+    import socket
+
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    a = socket.socket()
+    a.connect(srv.getsockname())
+    b, _ = srv.accept()
+    srv.close()
+    return a, b
+
+
+def test_fault_injector_deterministic_actions():
+    from distkeras_tpu.networking import (
+        FrameError, recv_frame, send_frame,
+    )
+
+    # drop: the 2nd frame >= 100 bytes never arrives
+    fi = FaultInjector(seed=0)
+    rule = fi.rule("drop", nth=2, min_bytes=100)
+    install_fault_injector(fi)
+    a, b = _socket_pair()
+    big = b"x" * 200
+    send_frame(a, big)
+    send_frame(a, big)     # dropped
+    send_frame(a, b"tiny")  # under min_bytes: unaffected
+    send_frame(a, big)
+    uninstall_fault_injector()
+    assert recv_frame(b) == big
+    assert recv_frame(b) == b"tiny"
+    assert recv_frame(b) == big
+    assert rule.matched == 3 and rule.fired == 1
+    a.close()
+    b.close()
+    # kill: connection dies exactly at the nth frame
+    fi = FaultInjector(seed=0)
+    fi.rule("kill", nth=3)
+    install_fault_injector(fi)
+    a, b = _socket_pair()
+    send_frame(a, b"one")
+    send_frame(a, b"two")
+    with pytest.raises(ConnectionError, match="fault injected"):
+        send_frame(a, b"three")
+    uninstall_fault_injector()
+    assert recv_frame(b) == b"one"
+    assert recv_frame(b) == b"two"
+    assert recv_frame(b) is None  # peer sees clean EOF after the kill
+    a.close()
+    b.close()
+    # truncate: peer observes a typed FrameError, not a clean EOF
+    fi = FaultInjector(seed=0)
+    fi.rule("truncate", nth=1, min_bytes=10)
+    install_fault_injector(fi)
+    a, b = _socket_pair()
+    with pytest.raises(ConnectionError):
+        send_frame(a, b"y" * 64)
+    uninstall_fault_injector()
+    with pytest.raises(FrameError, match="truncated"):
+        recv_frame(b)
+    a.close()
+    b.close()
+
+
+def test_fault_injector_seeded_prob_reproducible():
+    fired = []
+    for _ in range(2):
+        fi = FaultInjector(seed=123)
+        rule = fi.rule("drop", prob=0.5, repeat=True)
+        hits = [fi.check("send", 1) is not None for _ in range(32)]
+        fired.append(hits)
+        assert rule.fired == sum(hits)
+    assert fired[0] == fired[1]  # same seed, same fault sequence
+
+
+def test_probe_fault_seam_downs_and_recovers(model_and_params):
+    model, pa, _ = model_and_params
+    eng = _engine(model, pa)
+    srv = LMServer(eng).start()
+    try:
+        faulty = threading.Event()
+        mgr = ReplicaManager(
+            [Replica("127.0.0.1", srv.port, "r0")],
+            poll_interval=0.05, down_after=1, backoff_base=0.01,
+            registry=telemetry.MetricRegistry(),
+            probe_fault=lambda r: faulty.is_set(),
+        )
+        r = mgr.replicas[0]
+        mgr.probe(r)
+        assert r.state != DOWN
+        faulty.set()
+        mgr.probe(r)
+        assert r.state == DOWN
+        faulty.clear()
+        time.sleep(0.05)  # let the backoff gate expire
+        mgr.probe(r)
+        assert r.state != DOWN
+        mgr.stop()
+    finally:
+        srv.stop()
+
+
+# -- checkpoint restore validation -------------------------------------------
+
+
+def test_restore_like_mismatch_raises_typed(tmp_path):
+    """Checkpoint.restore(like=) names the first mismatched leaf in a
+    typed error instead of letting orbax silently restore the saved
+    shapes (the pre-typed failure was a broadcast error far from the
+    cause)."""
+    import collections
+
+    from distkeras_tpu.checkpoint import (
+        Checkpointer, CheckpointMismatchError,
+    )
+
+    Opt = collections.namedtuple("Opt", ["mu", "nu"])
+    ck = Checkpointer(str(tmp_path), every_steps=1)
+    params = {"w": np.arange(12, dtype=np.float32).reshape(3, 4)}
+    opt = (Opt(mu={"w": np.zeros((3, 4), np.float32)},
+               nu={"w": np.ones((3, 4), np.float32)}),
+           np.zeros((), np.int32))
+    ck.maybe_save(1, params, opt_state=opt, extra={"epoch": 2})
+    ck.wait()
+    good = {"params": params, "opt_state": opt, "extra": {"epoch": 0}}
+    step, state = ck.restore(like=good)
+    assert step == 1
+    assert isinstance(state["opt_state"][0], Opt)  # template structure
+    # shape mismatch deep in the tree: typed, names the leaf
+    bad = {"params": {"w": np.zeros((3, 5), np.float32)},
+           "opt_state": opt, "extra": {"epoch": 0}}
+    with pytest.raises(CheckpointMismatchError, match="shape") as ei:
+        ck.restore(like=bad)
+    assert "params/w" in str(ei.value) and ei.value.leaf == "params/w"
+    # dtype mismatch
+    bad = {"params": {"w": np.zeros((3, 4), np.int32)},
+           "opt_state": opt, "extra": {"epoch": 0}}
+    with pytest.raises(CheckpointMismatchError, match="dtype"):
+        ck.restore(like=bad)
+    # structural mismatch: a leaf only the template has
+    bad = {"params": {"w": params["w"],
+                      "extra_leaf": np.zeros((2,), np.float32)},
+           "opt_state": opt, "extra": {"epoch": 0}}
+    with pytest.raises(CheckpointMismatchError, match="no leaf"):
+        ck.restore(like=bad)
+    ck.close()
+
+
+# -- rendering ----------------------------------------------------------------
+
+
+def test_report_flight_renders_weight_version(tmp_path, capsys):
+    from distkeras_tpu.telemetry.flight import FlightRecorder
+    from distkeras_tpu.telemetry.report import report_flight
+
+    fr = FlightRecorder(capacity=8)
+    for i, wv in enumerate([1, 1, 2, 2]):
+        fr.record({"kind": "tick", "tick": i, "t": float(i),
+                   "tick_ms": 1.0, "plan_ms": 0.2, "device_ms": 0.6,
+                   "stream_ms": 0.2, "occupancy": 1, "queue_depth": 0,
+                   "decode_tokens": 1, "prefill_tokens": 0,
+                   "emitted": 1, "slots": [None],
+                   "weight_version": wv})
+    path = str(tmp_path / "f.jsonl")
+    fr.dump(path)
+    report_flight(path)
+    out = capsys.readouterr().out
+    assert "w=v1" in out and "w=v2" in out
+    assert "1 swap(s)" in out
+    # an all-v1 dump keeps the column silent (no noise pre-update)
+    fr2 = FlightRecorder(capacity=4)
+    fr2.record({"kind": "tick", "tick": 0, "t": 0.0, "tick_ms": 1.0,
+                "plan_ms": 0.2, "device_ms": 0.6, "stream_ms": 0.2,
+                "occupancy": 0, "queue_depth": 0, "decode_tokens": 0,
+                "prefill_tokens": 0, "emitted": 0, "slots": [None],
+                "weight_version": 1})
+    path2 = str(tmp_path / "f2.jsonl")
+    fr2.dump(path2)
+    report_flight(path2)
+    assert "w=v1" not in capsys.readouterr().out
