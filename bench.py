@@ -319,6 +319,7 @@ def main():
     out.update(serve_tier_bench())
     out.update(serve_disagg_bench())
     out.update(serve_update_bench())
+    out.update(serve_fleet_bench())
     print(json.dumps(out))
 
 
@@ -357,6 +358,47 @@ def serve_update_bench():
     except Exception as e:  # error-folded: a live-update regression
         # must land as a worse number, not a dead BENCH line
         return {"serve_update_error": f"{type(e).__name__}: {e}"}
+
+
+def serve_fleet_bench():
+    """Elastic-fleet-controller numbers for the BENCH trajectory:
+    interactive p99 ITL through the 10x burst, batch-tier TTFT (the
+    QoS class that gives), the controller's action counts, and the
+    determinism/zero-loss flags. Self-asserts are off
+    (``checks=False``) and errors are folded into the JSON, same
+    policy as the other serving lines."""
+    import os
+    import sys
+
+    sys.path.insert(
+        0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "benchmarks"))
+    try:
+        import serve_bench
+
+        r = serve_bench.run_fleet_sim(smoke=True, checks=False)
+        return {
+            "serve_fleet_burst_itl_p99_ms":
+                r["burst_itl_p99_interactive_ms"],
+            "serve_fleet_burst_batch_ttft_p99_ms":
+                r["burst_ttft_p99_batch_ms"],
+            "serve_fleet_scale_ups": r["scale_ups"],
+            "serve_fleet_scale_downs": r["scale_downs"],
+            "serve_fleet_oscillations": r["oscillations"],
+            "serve_fleet_replay_deterministic":
+                r["replay_deterministic"],
+            "serve_fleet_post_kill_scale_up":
+                r["post_kill_scale_up"],
+            "serve_fleet_lost_streams": r["lost_streams"],
+            "serve_fleet_batch_preempted_chunks":
+                r["batch_preempted_chunks"],
+            "serve_fleet_steady_recompiles":
+                len(r["steady_recompiles"]),
+            "serve_fleet_config": r["config"],
+        }
+    except Exception as e:  # error-folded: a controller regression
+        # must land as a worse number, not a dead BENCH line
+        return {"serve_fleet_error": f"{type(e).__name__}: {e}"}
 
 
 def serve_disagg_bench():

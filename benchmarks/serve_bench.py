@@ -1720,6 +1720,440 @@ def run_router(smoke=False, replicas=3, checks=True):
     return json.loads(line)
 
 
+def bench_fleet_sim(V=256, D=64, H=2, L=2, slots=2,
+                    min_replicas=1, max_replicas=3,
+                    n_tenants=4, prefix_len=64, tail_len=16,
+                    batch_body=256, interactive_new=24, batch_new=8,
+                    block_size=16, prefill_chunk=32,
+                    tick_token_budget=48,
+                    baseline_clients=2, ramp_clients=12,
+                    burst_clients=20, batch_clients=4,
+                    think_time=0.005,
+                    baseline_s=1.5, ramp_s=5.0, burst_s=7.0,
+                    kill_after_s=2.0, settle_timeout_s=45.0,
+                    itl_slo_ms=500.0, seed=0, dtype="float32",
+                    smoke=False, checks=True):
+    """Elastic-fleet simulation: the :class:`Autoscaler` control loop
+    driven end to end by a deterministic, seeded load model shaped
+    like a diurnal million-user trace scaled to CI — a baseline
+    trickle, an arrival ramp, a 10x interactive burst with long-prompt
+    batch traffic riding along (tenant-skewed prompts throughout), a
+    replica kill at the worst moment, then silence.
+
+    The fleet starts at ``min_replicas`` in-process LMServer replicas
+    (one per forced host device) behind the Router; ``max_replicas``
+    more are pre-built, warmed, and ``mark_steady()``-ed into a spare
+    pool — the ``spawn`` actuator hands them to the controller, which
+    is exactly how a real fleet holds warm standbys so elasticity
+    never pays a compile (and how this bench can assert zero
+    steady-state recompiles *through* scale-ups). Load is closed-loop
+    per phase — N concurrent clients with seeded think time — so queue
+    pressure is machine-speed-independent: the controller's signals,
+    not wall-clock token rates, are what the phases shape.
+
+    Interactive traffic rides the default QoS tier; batch clients
+    submit ``tier="batch"`` long-prompt requests that the scheduler
+    admits only behind the interactive queue and whose prefill chunks
+    are preempted first under ``tick_token_budget`` pressure — the
+    burst phase is where batch gives so interactive holds.
+
+    ``--smoke`` self-asserts the controller contract end to end:
+
+    - determinism: ``Autoscaler.replay()`` of the recorded signal
+      timeline through a fresh DecisionEngine reproduces the live
+      decision sequence exactly (same seed → same signals → same
+      scaling decisions);
+    - convergence without flap: the fleet reaches ``max_replicas``
+      on the ramp, returns to ``min_replicas`` after the traffic
+      stops, and the action sequence is monotone — zero scale-ups
+      after the first scale-down (the hysteresis/cooldown law);
+    - QoS isolation: interactive p99 ITL during the burst stays
+      within ``itl_slo_ms`` while batch absorbs the degradation
+      (batch p99 TTFT above interactive's, batch prefill chunks
+      preempted at least once);
+    - resilience: a replica killed mid-burst loses zero streams
+      (router replay) and the controller replaces it from the spare
+      pool (a scale-up after the kill);
+    - zero steady-state recompiles across every engine, spares and
+      scale-ups included.
+
+    Needs ``max_replicas + 1`` local devices — run via
+    :func:`run_fleet_sim`, which forces virtual host devices when the
+    process is short (CPU CI)."""
+    from distkeras_tpu import telemetry
+    from distkeras_tpu.models import get_model
+    from distkeras_tpu.serving import (
+        Autoscaler, FIFOScheduler, LMServer, Router, ServingClient,
+        ServingEngine,
+    )
+
+    n_servers = max_replicas + 1  # kill consumes one for good
+    if len(jax.devices()) < n_servers:
+        raise RuntimeError(
+            f"bench_fleet_sim wants {n_servers} devices (one per "
+            f"replica incl. the post-kill spare), have "
+            f"{len(jax.devices())} — run via --fleet-sim (it forces "
+            f"host devices when short)"
+        )
+    max_len = prefix_len + batch_body + max(interactive_new, batch_new)
+    max_len += (-max_len) % block_size
+    max_blocks = max_len // block_size
+    num_blocks = (1 + slots * max_blocks
+                  + n_tenants * (prefix_len // block_size) + 8)
+    model = get_model(
+        "transformer_lm", vocab_size=V, d_model=D, num_heads=H,
+        num_layers=L, max_len=max_len, dtype=jnp.dtype(dtype),
+        attention="dense",
+    )
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 4), jnp.int32))
+
+    # ---- the deterministic trace: tenant-skewed prompts, precomputed
+    # from the seed so two runs offer the identical request sequence
+    rng = np.random.default_rng(seed)
+    tenants = [rng.integers(0, V, size=prefix_len).astype(np.int32)
+               for _ in range(n_tenants)]
+    skew = np.array([1.0 / (k + 1) for k in range(n_tenants)])
+    skew /= skew.sum()  # zipf-ish: tenant 0 dominates
+
+    def make_trace(n, body_len):
+        return [np.concatenate([
+            tenants[int(rng.choice(n_tenants, p=skew))],
+            rng.integers(0, V, size=body_len).astype(np.int32),
+        ]) for _ in range(n)]
+
+    trace_i = make_trace(1024, tail_len)
+    trace_b = make_trace(128, batch_body)
+
+    # ---- fleet: every server pre-built and warmed so a scale-up is a
+    # pool pop, never a compile
+    devices = jax.devices()
+    servers = {}
+    for i in range(n_servers):
+        reg = telemetry.MetricRegistry()
+        tracer = telemetry.Tracer(pid=1000 + i)
+        eng = ServingEngine(
+            model, params, slots=slots, paged=True,
+            block_size=block_size, num_blocks=num_blocks,
+            prefill_chunk=prefill_chunk,
+            scheduler=FIFOScheduler(
+                tick_token_budget=tick_token_budget,
+                registry=reg, tracer=tracer),
+            registry=reg, tracer=tracer,
+            device=devices[i % len(devices)],
+        )
+        # per-replica SLO monitor: the controller's burn signals flow
+        # through manager.aggregate_alerts() -> these monitors. Bounds
+        # are lenient — this sim drives scaling with queue depth; the
+        # burn-driven paths are covered by tests/test_controller.py
+        slo = telemetry.SloMonitor(
+            telemetry.default_serving_rules(
+                itl_p99_ms=10_000.0, ttft_p99_ms=120_000.0,
+                max_queue_depth=1e9, max_expiry_per_s=1e9),
+            registry=reg, tracer=tracer, interval_s=0.25)
+        servers[f"r{i}"] = LMServer(eng, slo=slo).start()
+
+    wrng = np.random.default_rng(999)
+    for s in servers.values():
+        c = ServingClient("127.0.0.1", s.port)
+        pref = wrng.integers(0, V, size=prefix_len).astype(np.int32)
+        tail_a = wrng.integers(0, V, size=tail_len).astype(np.int32)
+        # cold prefix, full repeat, a MID-block divergent tail (random
+        # tails in the trace birthday-collide on leading tokens, so
+        # the copy-on-write block copy is a steady-state shape), and
+        # the long batch prompt
+        tail_c = tail_a.copy()
+        tail_c[tail_len // 2:] = wrng.integers(
+            0, V, size=tail_len - tail_len // 2)
+        for tail in (tail_a, tail_a, tail_c,
+                     wrng.integers(0, V, size=batch_body
+                                   ).astype(np.int32)):
+            rid = c.generate(np.concatenate([pref, tail]),
+                             max_new_tokens=4)
+            c.result(rid, timeout=300)
+        c.close()
+    for s in servers.values():
+        s.engine.mark_steady()
+
+    router = Router(
+        [("127.0.0.1", servers["r0"].port, "r0")],
+        policy="affine", block_size=block_size,
+        spill_queue_depth=2, poll_interval=0.05,
+        down_after=1, backoff_base=0.05,
+        registry=telemetry.MetricRegistry(),
+        tracer=telemetry.Tracer(pid=1),
+    ).start()
+
+    pool_lock = threading.Lock()
+    spares = [f"r{i}" for i in range(1, n_servers)]
+
+    def spawn():
+        with pool_lock:
+            if not spares:
+                raise RuntimeError("spare pool exhausted")
+            name = spares.pop(0)
+        # a previously retired replica left the fleet drained;
+        # re-open admissions before it rejoins routing
+        servers[name].engine.end_drain()
+        return ("127.0.0.1", servers[name].port, name)
+
+    def retire(name):
+        with pool_lock:
+            spares.append(name)
+            spares.sort()
+
+    auto = Autoscaler(
+        router, spawn=spawn, retire=retire,
+        interval_s=0.2, drain_timeout_s=60.0,
+        registry=telemetry.MetricRegistry(),
+        tracer=telemetry.Tracer(pid=2),
+        min_replicas=min_replicas, max_replicas=max_replicas,
+        queue_high=3.0, queue_low=0.5,
+        up_consecutive=2, down_consecutive=8,
+        cooldown_s=1.5, rebalance=False,
+    )
+
+    # ---- closed-loop load: phase-tagged at submit time
+    client = ServingClient("127.0.0.1", router.port,
+                           request_timeout=300.0)
+    stop_evt = threading.Event()
+    phase_box = {"name": "baseline"}
+    lock = threading.Lock()
+    cursor = {"interactive": 0, "batch": 0}
+    samples: list = []
+    lost = [0]
+    threads: list = []
+
+    def worker(tier, wid):
+        prng = np.random.default_rng(seed * 7919 + wid)
+        trace = trace_i if tier == "interactive" else trace_b
+        new = interactive_new if tier == "interactive" else batch_new
+        while not stop_evt.is_set():
+            with lock:
+                i = cursor[tier]
+                cursor[tier] += 1
+            prompt = trace[i % len(trace)]
+            ph = phase_box["name"]
+            t0 = time.perf_counter()
+            try:
+                rid = client.generate(prompt, max_new_tokens=new,
+                                      tier=tier)
+                ttft = None
+                last = t0
+                itls = []
+                reason = None
+                for kind, val in client.frames(rid, timeout=300):
+                    t = time.perf_counter()
+                    if kind == "end":
+                        reason = val
+                        break
+                    if ttft is None:
+                        ttft = (t - t0) * 1e3
+                    else:
+                        itls.append((t - last) * 1e3)
+                    last = t
+            except Exception:
+                with lock:
+                    lost[0] += 1
+                continue
+            with lock:
+                if reason != "length":
+                    lost[0] += 1
+                samples.append({"phase": ph, "tier": tier,
+                                "ttft_ms": ttft, "itl_ms": itls})
+            if tier == "interactive" and think_time:
+                stop_evt.wait(float(prng.uniform(0.5, 1.5))
+                              * think_time)
+
+    def add_workers(tier, n):
+        for _ in range(n):
+            t = threading.Thread(target=worker,
+                                 args=(tier, len(threads)), daemon=True)
+            threads.append(t)
+            t.start()
+
+    auto.start()
+    add_workers("interactive", baseline_clients)
+    time.sleep(baseline_s)
+    phase_box["name"] = "ramp"
+    add_workers("interactive", ramp_clients - baseline_clients)
+    time.sleep(ramp_s)
+    phase_box["name"] = "burst"
+    add_workers("interactive", burst_clients - ramp_clients)
+    add_workers("batch", batch_clients)
+    time.sleep(kill_after_s)
+
+    # kill the busiest routable replica mid-burst (name tiebreak keeps
+    # the choice reproducible under equal load)
+    deadline = time.monotonic() + 30
+    routable = []
+    while time.monotonic() < deadline:
+        routable = [r.name for r in router.manager.routable()]
+        if len(routable) >= 2:
+            break
+        time.sleep(0.05)
+    by = router.stats()["router"]["inflight_by_replica"]
+    killed = max(routable, key=lambda n: (by.get(n, 0), n))
+    # stamp BEFORE stop(): the manager sees the sockets die the moment
+    # stop() starts closing them, so the controller's replacement
+    # scale-up can fire while stop() is still joining threads
+    kill_t = time.monotonic()
+    servers[killed].stop()
+    time.sleep(max(burst_s - kill_after_s, 0.0))
+
+    phase_box["name"] = "settle"
+    stop_evt.set()
+    for t in threads:
+        t.join(timeout=600)
+    deadline = time.monotonic() + settle_timeout_s
+    while time.monotonic() < deadline:
+        if len(router.manager.routable()) <= min_replicas:
+            break
+        time.sleep(0.1)
+    time.sleep(0.5)  # a few more polls observing the converged fleet
+    auto.stop()
+
+    # ---- harvest
+    replay_ok = auto.replay() == auto.decisions()
+    acts = list(auto.events)
+    ups = [e for e in acts if e["action"] == "scale_up"]
+    downs = [e for e in acts if e["action"] == "scale_down"]
+    osc = 0
+    seen_down = False
+    for e in acts:
+        if e["action"] == "scale_down":
+            seen_down = True
+        elif e["action"] == "scale_up" and seen_down:
+            osc += 1
+    recomp: dict = {}
+    preempt = {"interactive": 0, "batch": 0}
+    for s in servers.values():
+        recomp.update(s.engine.recompiles_since_mark())
+        try:
+            qos = s.engine.stats().get("qos", {})
+        except Exception:
+            qos = {}
+        for t in preempt:
+            preempt[t] += int(qos.get(t, {}).get("preempted_chunks", 0))
+
+    def pct(vals, q):
+        return (round(float(np.percentile(np.asarray(vals), q)), 1)
+                if vals else None)
+
+    burst_i = [s for s in samples
+               if s["phase"] == "burst" and s["tier"] == "interactive"]
+    burst_b = [s for s in samples
+               if s["phase"] == "burst" and s["tier"] == "batch"]
+    result = {
+        "replay_deterministic": replay_ok,
+        "scale_ups": len(ups),
+        "scale_downs": len(downs),
+        "oscillations": osc,
+        "actuation_failures": sum(1 for e in acts if not e.get("ok")),
+        "max_routable": max(s["replicas"]
+                            for _, s in auto.signal_log),
+        "final_routable": auto.signal_log[-1][1]["replicas"],
+        "killed": killed,
+        "post_kill_scale_up": any(e["t"] >= kill_t for e in ups),
+        "lost_streams": lost[0],
+        "requests_interactive": sum(
+            1 for s in samples if s["tier"] == "interactive"),
+        "requests_batch": sum(
+            1 for s in samples if s["tier"] == "batch"),
+        "burst_itl_p99_interactive_ms": pct(
+            [g for s in burst_i for g in s["itl_ms"]], 99),
+        "burst_ttft_p99_interactive_ms": pct(
+            [s["ttft_ms"] for s in burst_i
+             if s["ttft_ms"] is not None], 99),
+        "burst_ttft_p99_batch_ms": pct(
+            [s["ttft_ms"] for s in burst_b
+             if s["ttft_ms"] is not None], 99),
+        "itl_slo_ms": itl_slo_ms,
+        "batch_preempted_chunks": preempt["batch"],
+        "interactive_preempted_chunks": preempt["interactive"],
+        "controller_polls": len(auto.signal_log),
+        "actions": [{k: e.get(k) for k in
+                     ("action", "reason", "replica", "ok")}
+                    for e in acts],
+        "steady_recompiles": recomp,
+        "n_devices": len(jax.devices()),
+        "backend": jax.default_backend(),
+        "config": f"d{D}/h{H}/L{L}/v{V}-fleet{min_replicas}.."
+                  f"{max_replicas}x{slots}slots-tenants{n_tenants}"
+                  f"-burst{burst_clients}+{batch_clients}batch"
+                  f"-budget{tick_token_budget}-{dtype}"
+                  + ("-smoke" if smoke else ""),
+    }
+    if smoke and checks:
+        # the controller contract, self-asserted (see docstring)
+        assert result["replay_deterministic"], result
+        assert result["actuation_failures"] == 0, result
+        assert result["scale_ups"] >= 2, result
+        assert result["max_routable"] == max_replicas, result
+        assert result["scale_downs"] >= 1, result
+        assert result["final_routable"] == min_replicas, result
+        assert result["oscillations"] == 0, result
+        assert result["lost_streams"] == 0, result
+        assert result["post_kill_scale_up"], result
+        assert result["burst_itl_p99_interactive_ms"] is not None, result
+        assert (result["burst_itl_p99_interactive_ms"]
+                <= itl_slo_ms), result
+        assert (result["burst_ttft_p99_batch_ms"]
+                > result["burst_ttft_p99_interactive_ms"]), result
+        assert result["batch_preempted_chunks"] >= 1, result
+        assert result["steady_recompiles"] == {}, result
+    client.close()
+    router.stop()
+    for s in servers.values():
+        try:
+            s.stop()
+        except Exception:
+            pass
+    print(json.dumps(result), flush=True)
+    return result
+
+
+def run_fleet_sim(smoke=False, checks=True, max_replicas=3):
+    """bench_fleet_sim with the respawn pattern: when this process has
+    fewer devices than the fleet wants (``max_replicas + 1``), re-exec
+    in a subprocess with forced virtual host devices (the env must be
+    set before XLA initializes). Returns the bench's JSON dict either
+    way."""
+    need = max_replicas + 1
+    if len(jax.devices()) >= need:
+        return bench_fleet_sim(smoke=smoke, checks=checks,
+                               max_replicas=max_replicas)
+
+    import subprocess
+
+    env = dict(os.environ)
+    flags = " ".join(
+        f for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    )
+    env["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={need}"
+    ).strip()
+    env["JAX_PLATFORMS"] = "cpu"
+    cmd = [sys.executable, os.path.abspath(__file__), "--fleet-sim"]
+    if smoke:
+        cmd.append("--smoke")
+    if not checks:
+        cmd.append("--no-checks")
+    proc = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                          timeout=1800)
+    sys.stderr.write(proc.stderr)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"fleet-sim subprocess failed "
+            f"(rc={proc.returncode}):\n{proc.stderr[-2000:]}\n"
+            f"{proc.stdout[-2000:]}"
+        )
+    line = [ln for ln in proc.stdout.splitlines() if ln.startswith("{")][-1]
+    print(line, flush=True)
+    return json.loads(line)
+
+
 def bench_disagg(V=64, D=256, H=4, L=2, replicas=3, slots=3,
                  n_short=12, short_prompt=8, short_new=8,
                  n_long=3, long_prompt=1024, long_new=2, long_every=2,
@@ -2633,6 +3067,16 @@ def main():
     ap.add_argument("--replicas", type=int, default=3,
                     help="replica count for --router/--disagg/"
                          "--live-update (default 3)")
+    ap.add_argument("--fleet-sim", action="store_true",
+                    help="elastic-fleet simulation: the Autoscaler "
+                         "control loop under a seeded diurnal load "
+                         "model (baseline/ramp/10x burst with QoS "
+                         "batch tier/replica kill/settle), asserting "
+                         "deterministic replay, flap-free "
+                         "convergence, interactive SLO held while "
+                         "batch gives, and zero lost streams; forces "
+                         "virtual host devices when the process is "
+                         "short")
     ap.add_argument("--no-checks", action="store_true",
                     help="disable the --smoke self-asserts (used by "
                          "the flagship bench.py fold, where a fabric "
@@ -2645,6 +3089,13 @@ def main():
         if args.prefill_chunk is not None:
             kw["prefill_chunk"] = args.prefill_chunk
         bench_pipeline(**kw)
+        return
+    if args.fleet_sim:
+        kw = dict(smoke=args.smoke, checks=not args.no_checks)
+        if len(jax.devices()) >= 4:
+            bench_fleet_sim(**kw)
+        else:
+            run_fleet_sim(**kw)
         return
     if args.live_update:
         kw = dict(smoke=args.smoke, replicas=args.replicas,

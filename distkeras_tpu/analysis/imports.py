@@ -5,9 +5,9 @@ Two declared boundaries, both prose in ARCHITECTURE.md until now:
 1. **Stdlib-only layers.** ``telemetry/`` must import no third-party
    module (instrumentation must never perturb device code, and every
    subsystem must be able to import it without cycles), and the fabric
-   layer (``serving/router.py``, ``serving/fleet.py``) shares the
-   constraint so a router process never needs jax on its path
-   *directly*. Intra-package imports are allowed (layering between
+   layer (``serving/router.py``, ``serving/fleet.py``,
+   ``serving/controller.py``) shares the constraint so a router or
+   fleet-controller process never needs jax on its path *directly*. Intra-package imports are allowed (layering between
    package modules is a different concern); any other non-stdlib
    import is flagged.
 2. **No test imports in package code.** ``distkeras_tpu/`` must never
@@ -36,6 +36,7 @@ DEFAULT_STDLIB_ONLY = (
     "distkeras_tpu/telemetry/",
     "distkeras_tpu/serving/router.py",
     "distkeras_tpu/serving/fleet.py",
+    "distkeras_tpu/serving/controller.py",
 )
 
 # roots package code must never import from

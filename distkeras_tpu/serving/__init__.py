@@ -54,6 +54,10 @@ from distkeras_tpu.serving.fleet import (  # noqa: F401
     merge_metric_snapshots,
 )
 from distkeras_tpu.serving.router import Router  # noqa: F401
+from distkeras_tpu.serving.controller import (  # noqa: F401
+    Autoscaler,
+    DecisionEngine,
+)
 from distkeras_tpu.serving.weights import (  # noqa: F401
     CheckpointWatcher,
     ParameterServerFeed,
@@ -87,6 +91,8 @@ __all__ = [
     "ReplicaManager",
     "merge_metric_snapshots",
     "Router",
+    "Autoscaler",
+    "DecisionEngine",
     "WeightPushError",
     "serialize_weights",
     "deserialize_weights",

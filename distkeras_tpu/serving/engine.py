@@ -97,6 +97,7 @@ from distkeras_tpu.serving.prefix import RadixPrefixIndex
 from distkeras_tpu.serving.weights import validate_like
 from distkeras_tpu.serving.scheduler import (
     DEFAULT_PREFILL_CHUNK,
+    QOS_TIERS,
     DrainingError,
     FIFOScheduler,
     Request,
@@ -1664,6 +1665,25 @@ class ServingEngine:
             labelnames=("phase",))
         self._m_cp = {ph: self._m_critical.labels(phase=ph)
                       for ph in ("queue", "prefill", "decode", "device")}
+        # QoS classes (PR 18): per-tier latency histograms and
+        # critical-path attribution, so the interactive tier's SLO can
+        # be monitored (and alerted on) independently of how badly the
+        # batch tier is being degraded to protect it. New families
+        # rather than a tier label on the unlabeled serving_ttft_ms /
+        # serving_itl_ms — existing dashboards and SLO rules keep
+        # reading the fleet-wide series unchanged.
+        self._m_qos_ttft = reg.histogram(
+            "serving_qos_ttft_ms",
+            "submit to first token by QoS tier (ms)",
+            labelnames=("tier",))
+        self._m_qos_itl = reg.histogram(
+            "serving_qos_itl_ms",
+            "inter-token latency by QoS tier (ms)",
+            labelnames=("tier",))
+        self._m_qos_critical = reg.histogram(
+            "serving_qos_critical_path_ms",
+            "per-request critical-path attribution by QoS tier (ms)",
+            labelnames=("tier", "phase"))
         # live weight updates (the train→serve loop): the currently
         # served weight version, swap count, and how long each atomic
         # hot swap took (validation + staged device upload + rebind)
@@ -1685,9 +1705,14 @@ class ServingEngine:
                seed: int = 0, eos_id: Optional[int] = None,
                top_k: Optional[int] = None, top_p: Optional[float] = None,
                deadline_s: Optional[float] = None,
+               tier: str = "interactive",
                trace_id: Optional[int] = None,
                parent_span: Optional[str] = None) -> Request:
         """Queue one request; returns it (consume ``request.stream``).
+        ``tier`` is the QoS class (one of
+        :data:`~distkeras_tpu.serving.scheduler.QOS_TIERS`):
+        interactive requests are admitted and dealt prefill budget
+        before batch ones, and land in per-tier latency histograms.
         ``trace_id`` joins the request to an upstream-propagated
         telemetry trace (the TCP front-end forwards the wire ``trace``
         field here, so one id follows a request across processes);
@@ -1721,11 +1746,15 @@ class ServingEngine:
             top_k = min(top_k, self.model.vocab_size)
         if top_p is not None and not 0.0 < top_p <= 1.0:
             raise ValueError(f"top_p must be in (0, 1]; got {top_p}")
+        if tier not in QOS_TIERS:
+            raise ValueError(
+                f"unknown QoS tier {tier!r}; expected one of {QOS_TIERS}"
+            )
         req = Request(
             prompt=prompt, max_new_tokens=max_new_tokens,
             temperature=temperature, seed=seed, eos_id=eos_id,
             top_k=top_k, top_p=top_p, deadline_s=deadline_s,
-            trace_id=trace_id, parent_span=parent_span,
+            tier=tier, trace_id=trace_id, parent_span=parent_span,
         )
         return self.scheduler.submit(req)
 
@@ -1860,6 +1889,28 @@ class ServingEngine:
         undrain). Idempotent; served over TCP as the ``drain`` op's
         ``undrain`` field (:meth:`ServingClient.undrain`)."""
         self.draining = False
+
+    def set_role(self, role: str) -> str:
+        """Reconfigure the replica's advertised specialization (the
+        fleet controller's rebalancing primitive: drain → ``set_role``
+        → undrain flips a spare mixed replica into the pool that is
+        burning its SLO). Engine-thread-only, like
+        :meth:`update_weights` — TCP handler threads marshal through
+        :meth:`call_in_loop` (the ``reconfigure`` wire op does), so
+        the flip lands between ticks. The role only gates how the
+        router classifies the replica and which admissions it sends;
+        the compiled tick functions are role-independent, so a flip
+        can never cause a steady-state recompile. Callers should flip
+        only a drained replica — in-flight mixed work on a
+        newly-"prefill" replica still finishes correctly, but the
+        router's pool accounting is cleanest across a drain."""
+        if role not in ("mixed", "prefill", "decode"):
+            raise ValueError(
+                f"unknown role {role!r}: expected 'mixed', 'prefill', "
+                f"or 'decode'"
+            )
+        self.role = role
+        return role
 
     @property
     def drained(self) -> bool:
@@ -2620,7 +2671,8 @@ class ServingEngine:
             key=lambda p: p[1].admit_seq,
         )
         takes = self.scheduler.plan_prefill(
-            n_dec, [len(st.pending) for _, st in pre], self.prefill_chunk
+            n_dec, [len(st.pending) for _, st in pre], self.prefill_chunk,
+            tiers=[st.req.tier for _, st in pre],
         )
         fed_tokens = sum(takes)
         C = self.prefill_chunk if fed_tokens else 1
@@ -2808,9 +2860,13 @@ class ServingEngine:
         for tok in toks:
             if req.first_token_t is None:
                 req.first_token_t = now
-                self._m_ttft_ms.observe((now - req.submit_t) * 1e3)
+                ttft_ms = (now - req.submit_t) * 1e3
+                self._m_ttft_ms.observe(ttft_ms)
+                self._m_qos_ttft.labels(tier=req.tier).observe(ttft_ms)
             else:
-                self._m_itl_ms.observe((now - req.last_token_t) * 1e3)
+                itl_ms = (now - req.last_token_t) * 1e3
+                self._m_itl_ms.observe(itl_ms)
+                self._m_qos_itl.labels(tier=req.tier).observe(itl_ms)
             req.last_token_t = now
             req.stream._put(tok)
 
@@ -2947,6 +3003,7 @@ class ServingEngine:
         takes, widths = self.scheduler.plan_spec(
             len(dec), [len(st.pending) for _, st in pre],
             self.prefill_chunk, want,
+            tiers=[st.req.tier for _, st in pre],
         )
         fed_tokens = sum(takes)
         W = max(self.prefill_chunk, k + 1) if fed_tokens else k + 1
@@ -3226,10 +3283,15 @@ class ServingEngine:
         # observed by the TCP pump / router into the same family)
         admit_t = req.admit_t or req.submit_t
         prefill_done = req.prefill_done_t or admit_t
-        self._m_cp["queue"].observe((admit_t - req.submit_t) * 1e3)
-        self._m_cp["prefill"].observe((prefill_done - admit_t) * 1e3)
-        self._m_cp["device"].observe(device_ms)
-        self._m_cp["decode"].observe(max(decode_ms - device_ms, 0.0))
+        phase_ms = (
+            ("queue", (admit_t - req.submit_t) * 1e3),
+            ("prefill", (prefill_done - admit_t) * 1e3),
+            ("device", device_ms),
+            ("decode", max(decode_ms - device_ms, 0.0)),
+        )
+        for ph, ms in phase_ms:
+            self._m_cp[ph].observe(ms)
+            self._m_qos_critical.labels(tier=req.tier, phase=ph).observe(ms)
         self._m_requests.labels(reason=reason).inc()
         req.stream._finish(reason)
         self.metrics.summary(
@@ -3359,6 +3421,10 @@ class ServingEngine:
                 "stream_ms": stream_ms,
                 "occupancy": occupancy, "queue_depth": queue_depth,
                 "queue_oldest_wait_s": oldest,
+                # per-tier backlog: a postmortem can show the batch
+                # queue absorbing an overload while interactive stays
+                # shallow (the QoS degradation order, as it happened)
+                "qos_depth": self.scheduler.depth_by_tier(),
                 "budget_limit": self.scheduler.tick_token_budget,
                 "decode_tokens": n_dec,
                 "prefill_tokens": prefill_tokens, "chunk": chunk,
@@ -3419,6 +3485,7 @@ class ServingEngine:
         THIS engine. The process-cumulative view (histograms, labeled
         series) is ``self.registry.collect()`` — served by the TCP
         ``metrics`` op and the HTTP endpoint."""
+        qos_depth = self.scheduler.depth_by_tier()
         out = {
             # replica specialization (disaggregated serving): the
             # router classifies replicas into prefill/decode pools from
@@ -3481,6 +3548,23 @@ class ServingEngine:
                 ph: {"p50": self._m_critical.percentile(50, phase=ph),
                      "p99": self._m_critical.percentile(99, phase=ph)}
                 for ph in ("queue", "prefill", "decode", "device")
+            },
+            # QoS classes: per-tier queue depth and latency
+            # percentiles, plus how often a tier's prefill chunk was
+            # starved/truncated by tick-budget pressure — the evidence
+            # that overload degraded the batch tier first
+            "qos": {
+                t: {
+                    "queue_depth": qos_depth.get(t, 0),
+                    "ttft_p99_ms": self._m_qos_ttft.percentile(
+                        99, tier=t),
+                    "itl_p50_ms": self._m_qos_itl.percentile(50, tier=t),
+                    "itl_p99_ms": self._m_qos_itl.percentile(99, tier=t),
+                    "preempted_chunks": (
+                        self.scheduler._m_qos_preempted
+                        .labels(tier=t).value),
+                }
+                for t in QOS_TIERS
             },
         }
         if self.spec:

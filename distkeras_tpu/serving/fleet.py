@@ -57,6 +57,23 @@ _SUM_KEYS = (
     "kv_blocks_exported", "kv_blocks_imported", "weight_swaps",
 )
 
+# stats() keys merged by MAX: versions, where a fleet sum is nonsense
+# (three replicas serving weight_version 7 are not at version 21 — the
+# fleet is at the highest version any replica has converged to, and a
+# laggard shows up as its per-replica snapshot disagreeing)
+_MAX_KEYS = ("weight_version",)
+
+# metric gauge families merged by MAX instead of SUM: versions and 0/1
+# flags. Everything else the serving stack exports as a gauge (blocks
+# in use, queue depth, occupancy) is an additive resource quantity
+# where the fleet sum is the right read.
+_GAUGE_MAX_FAMILIES = frozenset({
+    "serving_weight_version",  # version, not a quantity
+    "slo_alert_active",        # 0/1 flag per rule: any-firing, not count
+    "router_replica_up",       # 0/1 flag (labeled per replica, but a
+                               # nested router must not sum its parents')
+})
+
 
 class Replica:
     """One backend LM server as the router sees it. Thread-safety: the
@@ -128,14 +145,17 @@ class Replica:
 def merge_metric_snapshots(snapshots: Sequence[Dict[str, dict]],
                            ) -> Dict[str, dict]:
     """Merge :meth:`MetricRegistry.collect` snapshots from N replicas
-    into one fleet view: series with identical labels are summed —
-    counters and gauges by value, histograms bucket-by-bucket (plus sum
-    and count). Families whose type/labelnames disagree across replicas
-    are kept from the first snapshot only (a version-skewed replica must
-    not corrupt the fleet view). Gauges are summed because every gauge
-    the serving stack exports (blocks in use, queue depth, occupancy)
-    is an additive resource quantity; a non-additive gauge belongs in
-    per-replica stats, not the merged view."""
+    into one fleet view: series with identical labels are merged per
+    family policy — counters summed, histograms bucket-by-bucket (plus
+    sum and count), gauges summed when they are additive resource
+    quantities (blocks in use, queue depth, occupancy) but taken by
+    MAX for the version/flag families in :data:`_GAUGE_MAX_FAMILIES`
+    (summing ``serving_weight_version`` or ``slo_alert_active`` across
+    replicas yields nonsense — a fleet is at the highest version any
+    replica serves, and one firing alert must read 1, not N). Families
+    whose type/labelnames disagree across replicas are kept from the
+    first snapshot only (a version-skewed replica must not corrupt the
+    fleet view)."""
     out: Dict[str, dict] = {}
     for snap in snapshots:
         for name, fam in snap.items():
@@ -161,6 +181,10 @@ def merge_metric_snapshots(snapshots: Sequence[Dict[str, dict]],
                     s = dict(s)
                     cur["series"].append(s)
                     by_key[key] = s
+                elif (cur["type"] == "gauge"
+                        and name in _GAUGE_MAX_FAMILIES):
+                    have["value"] = max(have.get("value", 0.0),
+                                        s.get("value", 0.0))
                 elif cur["type"] in ("counter", "gauge"):
                     have["value"] = (have.get("value", 0.0)
                                      + s.get("value", 0.0))
@@ -275,8 +299,45 @@ class ReplicaManager:
                 client.close()
 
     def _loop(self):
-        while not self._stop.wait(self.poll_interval):
-            self.probe_all()
+        """Probe each replica on its own phase-offset schedule rather
+        than the whole fleet on one synchronized beat: N replicas
+        probed back-to-back every ``poll_interval`` is a self-inflicted
+        stats stampede (every engine answers a stats op in the same
+        instant, and the round-trip burst grows with the fleet). The
+        offset is a stable hash of the replica name — deterministic
+        across restarts, spread uniformly over the interval — and each
+        replica then repeats at ``poll_interval`` cadence, so the
+        probes of a large fleet interleave instead of clustering."""
+        now = time.monotonic()
+        next_t = {r.name: now + self._phase(r.name)
+                  for r in self.replicas}
+        tick = max(self.poll_interval / 4.0, 0.01)
+        while not self._stop.wait(tick):
+            now = time.monotonic()
+            for r in list(self.replicas):
+                due = next_t.get(r.name)
+                if due is None:
+                    # replica added at runtime: phase it in like the rest
+                    due = now + self._phase(r.name)
+                    next_t[r.name] = due
+                if now >= due:
+                    next_t[r.name] = now + self.poll_interval
+                    self.probe(r)
+                if self._stop.is_set():
+                    return
+            if len(next_t) != len(self.replicas):
+                live = {r.name for r in self.replicas}
+                for n in [n for n in next_t if n not in live]:
+                    del next_t[n]
+
+    def _phase(self, name: str) -> float:
+        """Deterministic per-replica probe phase in ``[0,
+        poll_interval)``, from a stable string hash (Python's ``hash``
+        is salted per process — two routers would disagree)."""
+        h = 0
+        for ch in name.encode():
+            h = (h * 131 + ch) & 0xFFFFFFFF
+        return (h / 0x100000000) * self.poll_interval
 
     # -- probing ------------------------------------------------------------
 
@@ -361,6 +422,38 @@ class ReplicaManager:
             except Exception:
                 pass  # a failover-hook bug must not kill the probe loop
 
+    # -- membership ---------------------------------------------------------
+
+    def add(self, replica: Replica) -> Replica:
+        """Join a replica to the fleet at runtime (the autoscaler's
+        scale-up actuator). One synchronous probe runs immediately so
+        the new replica enters routing with a live stats view instead
+        of waiting out a poll interval; the background loop then picks
+        it up on its own phase-offset schedule."""
+        if any(r.name == replica.name for r in self.replicas):
+            raise ValueError(
+                f"replica name {replica.name!r} already in the fleet"
+            )
+        # rebind-not-mutate: probe loop and routing policies iterate
+        # self.replicas lock-free; they see the old or the new list,
+        # both internally consistent
+        self.replicas = self.replicas + [replica]
+        self.probe(replica)
+        return replica
+
+    def remove(self, name: str) -> Replica:
+        """Retire a replica from the fleet at runtime (the scale-down
+        actuator; callers drain it first). The removed replica stops
+        being probed and routed immediately; its connection is left to
+        the caller to close (the router does, after forgetting its
+        affinity placements)."""
+        replica = self.get(name)
+        if len(self.replicas) <= 1:
+            raise ValueError("cannot remove the last replica")
+        self.replicas = [r for r in self.replicas if r.name != name]
+        self._m_up.labels(replica=name).set(0)
+        return replica
+
     # -- views --------------------------------------------------------------
 
     def get(self, name: str) -> Replica:
@@ -395,6 +488,10 @@ class ReplicaManager:
                 v = r.last_stats.get(k)
                 if v is not None:
                     fleet[k] = fleet.get(k, 0) + v
+            for k in _MAX_KEYS:
+                v = r.last_stats.get(k)
+                if v is not None:
+                    fleet[k] = max(fleet.get(k, v), v)
         hit, total = (fleet.get("prefix_hit_tokens"),
                       fleet.get("prompt_tokens"))
         if total and hit is not None:

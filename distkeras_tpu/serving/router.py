@@ -374,6 +374,7 @@ class Router:
         self.spill_min_free_blocks = spill_min_free_blocks
         self.max_replays = max_replays
         self.max_frame_bytes = max_frame_bytes
+        self.backend_request_timeout = backend_request_timeout
         self._rng = random.Random(seed)
         self._route_lock = threading.Lock()   # index + ring + rng
         self._rid_counter = itertools.count(1)
@@ -1194,6 +1195,8 @@ class Router:
                         })
                     elif op == "drain":
                         self._op_drain(conn, lock, msg)
+                    elif op == "reconfigure":
+                        self._op_reconfigure(conn, lock, msg)
                     elif op == "push_weights":
                         # the fleet half of live weight updates: the
                         # reassembled payload rolls across every
@@ -1274,7 +1277,8 @@ class Router:
             seed=int(msg.get("seed", 0)),
         )
         for k, cast in (("eos_id", int), ("top_k", int),
-                        ("top_p", float), ("deadline_s", float)):
+                        ("top_p", float), ("deadline_s", float),
+                        ("tier", str)):
             if msg.get(k) is not None:
                 params[k] = cast(msg[k])
         entry = _Entry(
@@ -1339,6 +1343,69 @@ class Router:
         self.manager.note_drain(replica)
         self._send(conn, lock, {"ok": 1, "draining": 1,
                                 "replica": replica.name, **reply})
+
+    def _op_reconfigure(self, conn, lock, msg: dict):
+        """Forward a role flip to one named backend replica (the
+        router itself has no role — ``replica=`` is required here,
+        unlike a direct LMServer). The replica's cached routing view
+        updates immediately: the next :meth:`_choose` sees the new
+        role without waiting for a probe cycle."""
+        name = msg.get("replica")
+        if name is None:
+            self._send(conn, lock, {
+                "ok": 0,
+                "error": "reconfigure through a router needs "
+                         "replica=<name> (the router has no role)",
+            })
+            return
+        replica = self.manager.get(str(name))
+        client = replica.client
+        if client is None:
+            self._send(conn, lock, {
+                "ok": 0, "error": f"replica {name!r} is not connected",
+            })
+            return
+        role = client.reconfigure(str(msg["role"]))
+        # refresh the cached stats the routing policy classifies on
+        # (stale role = wrong pool until the next probe)
+        if replica.last_stats:
+            replica.last_stats["role"] = role
+        self._send(conn, lock, {"ok": 1, "role": role,
+                                "replica": replica.name})
+
+    def add_replica(self, spec) -> "Replica":
+        """Grow the fleet at runtime (the autoscaler's scale-up
+        actuator): ``spec`` is a started replica's ``(host, port[,
+        name])`` — or a built :class:`Replica` — which joins probing,
+        the hash ring, and the routing pools immediately. The affinity
+        index is untouched: existing placements stay valid, and the
+        rebuilt ring only redirects the hash-policy share of keys that
+        now map to the new replica."""
+        if isinstance(spec, Replica):
+            replica = spec
+        else:
+            replica = Replica(
+                *spec, request_timeout=self.backend_request_timeout)
+        self.manager.add(replica)
+        with self._route_lock:
+            self.ring = _HashRing([r.name for r in self.manager.replicas])
+        return replica
+
+    def remove_replica(self, name: str) -> dict:
+        """Shrink the fleet at runtime (the autoscaler's scale-down
+        actuator). The caller is responsible for draining first —
+        removal is immediate: the replica leaves the ring and the
+        probe set, its affinity placements are forgotten, and its
+        router-held connection closes. Returns the removed replica's
+        last cached stats (the controller logs them with the
+        decision)."""
+        replica = self.manager.remove(name)
+        with self._route_lock:
+            self.ring = _HashRing([r.name for r in self.manager.replicas])
+            self.index.forget(replica.name)
+        last = dict(replica.last_stats)
+        replica.mark_down("removed from fleet")
+        return last
 
     def _op_push_weights(self, conn, lock, msg: dict, buf: dict):
         """One push_weights chunk at the fleet level: reassembly is

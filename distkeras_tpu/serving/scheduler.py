@@ -20,6 +20,17 @@ cap — at most N whole-prompt prefill dispatches per tick) is deprecated:
 passing it maps onto an equivalent token budget (N default-sized chunks
 per tick) with a :class:`DeprecationWarning`, and still bounds
 admissions per pop for engines running the legacy monolithic prefill.
+
+Requests carry a **QoS tier** (``Request.tier``, one of :data:`QOS_TIERS`:
+``"interactive"`` then ``"batch"``). The scheduler keeps one FIFO queue
+per tier and serves them in strict priority order — batch requests are
+admitted only when no interactive request is waiting, and under
+``tick_token_budget`` pressure :meth:`FIFOScheduler.plan_prefill` deals
+prompt chunks to interactive slots first, so overload starves the batch
+tier's prefill progress before it costs an interactive request anything.
+Within a tier nothing changes: FIFO order, no queue jumping past a head
+that is merely waiting for blocks. A fleet running only the default
+``interactive`` tier behaves exactly as the single-queue scheduler did.
 """
 
 from __future__ import annotations
@@ -41,6 +52,11 @@ from distkeras_tpu import telemetry
 # (also ServingEngine's default prefill_chunk — one legacy "prefill per
 # tick" becomes one default-sized chunk of prefill tokens per tick)
 DEFAULT_PREFILL_CHUNK = 64
+
+# QoS tiers in strict priority order: the admission queue and the
+# per-tick prefill budget both serve earlier tiers first, so overload
+# degrades the cheap tier before it touches the expensive one
+QOS_TIERS = ("interactive", "batch")
 
 
 class QueueFullError(RuntimeError):
@@ -119,6 +135,10 @@ class Request:
     top_k: Optional[int] = None
     top_p: Optional[float] = None
     deadline_s: Optional[float] = None
+    # QoS class (one of QOS_TIERS): interactive requests are admitted
+    # and dealt prefill budget before batch ones; per-tier latency
+    # histograms and SLO rules key off this
+    tier: str = "interactive"
     rid: int = field(default_factory=lambda: next(_rid_counter))
     stream: TokenStream = field(default_factory=TokenStream)
     # telemetry: allocated by FIFOScheduler.submit UNLESS the caller
@@ -213,7 +233,8 @@ class FIFOScheduler:
         self.restore_budget = restore_budget
         # legacy admissions-per-pop cap; None = free slots only
         self.max_prefills_per_tick = max_prefills_per_tick
-        self._q: deque = deque()
+        # one FIFO per QoS tier, served in QOS_TIERS priority order
+        self._qs = {t: deque() for t in QOS_TIERS}
         self._lock = threading.Lock()
         # incremental head bookkeeping: the head request's submit time
         # is cached at every queue mutation so oldest_age_s never
@@ -253,6 +274,17 @@ class FIFOScheduler:
             "serving_requests_total",
             "requests finished, by finish reason", labelnames=("reason",),
         )
+        self._m_qos_depth = self.registry.gauge(
+            "serving_qos_queue_depth",
+            "queued requests by QoS tier", labelnames=("tier",),
+        )
+        self._m_qos_preempted = self.registry.counter(
+            "serving_qos_preempted_total",
+            "prefill chunks starved or truncated by tick-budget "
+            "pressure, by tier", labelnames=("tier",),
+        )
+        for t in QOS_TIERS:
+            self._m_qos_depth.labels(tier=t).set(0)
 
     def submit(self, req: Request) -> Request:
         """Enqueue or raise :class:`QueueFullError` (backpressure).
@@ -261,27 +293,59 @@ class FIFOScheduler:
         UNLESS one was propagated from upstream (a router or remote
         client already minted the fleet-wide id; spans recorded here
         join that chain)."""
+        if req.tier not in QOS_TIERS:
+            raise ValueError(
+                f"unknown QoS tier {req.tier!r}; expected one of "
+                f"{QOS_TIERS}"
+            )
         if req.trace_id is None:
             req.trace_id = self.tracer.new_trace_id()
         with self._lock:
-            if len(self._q) >= self.max_queue_depth:
+            if self._depth_locked() >= self.max_queue_depth:
                 self._m_rejected.inc()
                 raise QueueFullError(
                     f"admission queue full "
                     f"(max_queue_depth={self.max_queue_depth})"
                 )
             req.submit_t = time.monotonic()
-            self._q.append(req)
-            depth = len(self._q)
-            if depth == 1:
-                self._head_submit_t = req.submit_t
+            self._qs[req.tier].append(req)
+            depth = self._depth_locked()
+            tier_depth = len(self._qs[req.tier])
+            self._refresh_head_locked()
         self._m_submitted.inc()
         self._m_depth.set(depth)
+        self._m_qos_depth.labels(tier=req.tier).set(tier_depth)
         return req
+
+    def _depth_locked(self) -> int:
+        return sum(len(q) for q in self._qs.values())
+
+    def _peek_head_locked(self) -> Optional[Tuple[str, Request]]:
+        """The next request :meth:`pop_admissible` would consider: head
+        of the highest-priority non-empty tier queue."""
+        for tier in QOS_TIERS:
+            if self._qs[tier]:
+                return tier, self._qs[tier][0]
+        return None
+
+    def _refresh_head_locked(self):
+        """Recompute the oldest-head timestamp across tiers (each tier
+        is FIFO, so its head is its oldest — the fleet-wide oldest wait
+        is the min over tier heads, which keeps a starving batch
+        request visible in the admission-latency signal even while
+        interactive traffic jumps ahead of it)."""
+        heads = [q[0].submit_t for q in self._qs.values() if q]
+        self._head_submit_t = min(heads) if heads else None
 
     def depth(self) -> int:
         with self._lock:
-            return len(self._q)
+            return self._depth_locked()
+
+    def depth_by_tier(self) -> dict:
+        """Queued requests per QoS tier (engine stats / flight
+        snapshots)."""
+        with self._lock:
+            return {t: len(q) for t, q in self._qs.items()}
 
     def oldest_age_s(self) -> float:
         """Seconds the head (oldest queued) request has been waiting;
@@ -315,11 +379,16 @@ class FIFOScheduler:
         admitted prompts through :meth:`plan_prefill`, so admission
         itself costs no prefill dispatch; a deprecated
         ``max_prefills_per_tick`` still caps the pop for legacy
-        monolithic-prefill engines). ``admissible`` is an optional
+        monolithic-prefill engines). Tier queues are served in strict
+        :data:`QOS_TIERS` priority order — every waiting interactive
+        request is considered before any batch one. ``admissible`` is
+        an optional
         resource gate (the paged engine's free-block check): when the
-        HEAD request fails it, popping stops — FIFO order is preserved
-        (no queue-jumping past a request that is merely waiting for
-        blocks), and the head retries next step. A head that failed
+        HEAD request fails it, popping stops — priority-then-FIFO order
+        is preserved (no queue-jumping past a request that is merely
+        waiting for blocks, not even by a lower tier: batch work must
+        not steal the blocks the interactive head waits for), and the
+        head retries next step. A head that failed
         the gate on each of the last TWO pops with no intervening
         :meth:`note_capacity_change` is short-circuited: the gate
         (radix matching + pool arithmetic on the paged engine) is not
@@ -338,19 +407,25 @@ class FIFOScheduler:
         now = time.monotonic()
         with self._lock:
             # expiry sweep first: the short-circuit must never keep a
-            # deadline-passed head queued
-            while self._q:
-                req = self._q[0]
-                if (req.deadline_s is not None
-                        and now - req.submit_t > req.deadline_s):
-                    expired.append(self._q.popleft())
-                    continue
-                break
+            # deadline-passed head queued (every tier head is swept —
+            # a batch head can expire while interactive traffic keeps
+            # jumping ahead of it)
+            for q in self._qs.values():
+                while q:
+                    req = q[0]
+                    if (req.deadline_s is not None
+                            and now - req.submit_t > req.deadline_s):
+                        expired.append(q.popleft())
+                        continue
+                    break
+            head = self._peek_head_locked()
             blocked = self._blocked
             if blocked is not None and (
-                    not self._q or blocked[0] is not self._q[0]):
+                    head is None or blocked[0] is not head[1]):
                 # the blocked head moved on (admitted elsewhere is
-                # impossible FIFO, but it can expire) — drop the state
+                # impossible FIFO, but it can expire — or a higher-tier
+                # arrival displaced it as the priority head) — drop the
+                # state
                 self._blocked = blocked = None
             if (admissible is not None and blocked is not None
                     and blocked[1] >= 2
@@ -359,31 +434,38 @@ class FIFOScheduler:
                 # released since: same inputs, same "no" — skip the scan
                 self.head_blocked_skips += 1
             else:
-                while self._q and len(admitted) < budget:
-                    req = self._q[0]
+                while len(admitted) < budget:
+                    head = self._peek_head_locked()
+                    if head is None:
+                        break
+                    tier, req = head
+                    q = self._qs[tier]
                     if (req.deadline_s is not None
                             and now - req.submit_t > req.deadline_s):
-                        expired.append(self._q.popleft())
+                        expired.append(q.popleft())
                         continue
                     if admissible is not None and not admissible(req):
                         streak = (blocked[1] + 1 if blocked is not None
                                   and blocked[0] is req else 1)
                         self._blocked = (req, streak, self._cap_epoch)
                         break
-                    admitted.append(self._q.popleft())
+                    admitted.append(q.popleft())
                     if blocked is not None and blocked[0] is req:
                         self._blocked = blocked = None
-            depth = len(self._q)
-            self._head_submit_t = (self._q[0].submit_t if self._q
-                                   else None)
+            depth = self._depth_locked()
+            qos_depths = {t: len(q) for t, q in self._qs.items()}
+            self._refresh_head_locked()
         for req in expired:
             self._expire(req)
         if admitted or expired:
             self._m_depth.set(depth)
+            for t, d in qos_depths.items():
+                self._m_qos_depth.labels(tier=t).set(d)
         return admitted, expired
 
     def plan_prefill(self, n_decoding: int, pending_lens: Sequence[int],
-                     chunk: int) -> List[int]:
+                     chunk: int,
+                     tiers: Optional[Sequence[str]] = None) -> List[int]:
         """Sarathi-style budget split for ONE mixed tick: every decoding
         slot reserves one budget token first (decode never stalls behind
         prefill), then the remainder is dealt to prefilling slots in
@@ -392,17 +474,45 @@ class FIFOScheduler:
         prefill progress this tick and retries next tick; starvation is
         bounded because decoding slots drain at max_new_tokens and free
         their reservations). Returns one token count per entry of
-        ``pending_lens``."""
+        ``pending_lens``.
+
+        ``tiers`` (one QoS tier per entry, parallel to
+        ``pending_lens``) makes the deal tier-aware: interactive slots
+        are dealt their chunks first (admission order within a tier),
+        batch slots get only what is left — under budget pressure the
+        batch tier's prefill stalls before an interactive chunk
+        shrinks. Slots whose chunk was truncated or zeroed by budget
+        pressure increment ``serving_qos_preempted_total{tier}``.
+        Without ``tiers`` the deal is tier-blind and byte-identical to
+        the pre-QoS scheduler."""
         remain = max(self.tick_token_budget - n_decoding, 0)
-        out: List[int] = []
-        for n in pending_lens:
-            take = min(chunk, int(n), remain)
-            out.append(take)
+        out = [0] * len(pending_lens)
+        if tiers is None:
+            order = list(range(len(pending_lens)))
+        else:
+            if len(tiers) != len(pending_lens):
+                raise ValueError(
+                    f"tiers/pending_lens length mismatch: "
+                    f"{len(tiers)} vs {len(pending_lens)}"
+                )
+            order = [i for t in QOS_TIERS
+                     for i, ti in enumerate(tiers) if ti == t]
+            order += [i for i, ti in enumerate(tiers)
+                      if ti not in QOS_TIERS]
+        for i in order:
+            n = int(pending_lens[i])
+            take = min(chunk, n, remain)
+            out[i] = take
             remain -= take
+            if tiers is not None and take < min(chunk, n):
+                self._m_qos_preempted.labels(
+                    tier=tiers[i] if tiers[i] in QOS_TIERS
+                    else QOS_TIERS[-1]).inc()
         return out
 
     def plan_spec(self, n_decoding: int, pending_lens: Sequence[int],
                   chunk: int, want_widths: Sequence[int],
+                  tiers: Optional[Sequence[str]] = None,
                   ) -> Tuple[List[int], List[int]]:
         """Budget split for one SPECULATIVE mixed tick: verify-window
         tokens are charged against the same ``tick_token_budget`` as
@@ -420,8 +530,11 @@ class FIFOScheduler:
         Prefill pressure therefore shrinks verify windows toward plain
         1-token decode instead of the other way around. Returns
         ``(prefill_takes, granted_widths)`` — one entry per
-        ``pending_lens`` / ``want_widths`` element respectively."""
-        takes = self.plan_prefill(n_decoding, pending_lens, chunk)
+        ``pending_lens`` / ``want_widths`` element respectively.
+        ``tiers`` is forwarded to :meth:`plan_prefill` (QoS-aware
+        chunk dealing)."""
+        takes = self.plan_prefill(n_decoding, pending_lens, chunk,
+                                  tiers=tiers)
         remain = max(
             self.tick_token_budget - n_decoding - sum(takes), 0
         )

@@ -11,7 +11,7 @@ Protocol (all frames are msgpack dicts):
   client → server
     {"op": "generate", "prompt": [ids], "max_new_tokens": n,
      "temperature"?, "seed"?, "eos_id"?, "top_k"?, "top_p"?,
-     "deadline_s"?, "trace"?: tid, "parent_span"?: name}
+     "deadline_s"?, "tier"?, "trace"?: tid, "parent_span"?: name}
     {"op": "stats"}
     {"op": "metrics"}                         # registry snapshot
     {"op": "trace_dump", "trace"?: tid, "limit"?: n}
@@ -23,6 +23,13 @@ Protocol (all frames are msgpack dicts):
     {"op": "drain"}                           # close admissions (graceful);
                                               # with "undrain": 1 reopen
                                               # them (rolling updates)
+    {"op": "reconfigure", "role": r}          # flip the replica's
+                                              # advertised role (mixed/
+                                              # prefill/decode) between
+                                              # ticks — the fleet
+                                              # controller's drain →
+                                              # reconfigure → undrain
+                                              # rebalancing primitive
     {"op": "push_weights", "seq": i, "n": k, "chunk": bytes,
      "version"?: v}                           # live weight update: one
                                               # serialized variables
@@ -71,6 +78,7 @@ Protocol (all frames are msgpack dicts):
     {"ok": 1, "flight": {"meta":..,"ticks":[..]}}   # FlightRecorder ring
     {"ok": 1, "alerts": [...]}                # SloMonitor.alerts()
     {"ok": 1, "draining": 1, "active": a, "queued": q}   # drain accepted
+    {"ok": 1, "role": r}                      # reconfigure applied
     {"ok": 1, "received": i}                  # push_weights chunk i < k-1
     {"ok": 1, "applied": 1, "version": v, "swap_ms": ms}
                                               # push_weights final chunk:
@@ -344,6 +352,12 @@ class LMServer:
                             deadline_s=(
                                 None if msg.get("deadline_s") is None
                                 else float(msg["deadline_s"])),
+                            # QoS class: omitted = interactive (the
+                            # expensive tier — existing clients keep
+                            # their latency guarantees unchanged)
+                            tier=(str(msg["tier"])
+                                  if msg.get("tier") is not None
+                                  else "interactive"),
                             # propagated trace context: a router (or
                             # tracing client) minted the id upstream —
                             # this replica's spans join that chain
@@ -459,6 +473,17 @@ class LMServer:
                                 "active": st["active_slots"],
                                 "queued": st["queue_depth"],
                             })
+                    elif op == "reconfigure":
+                        # role rebalancing: flip the replica's
+                        # advertised specialization. Marshalled onto
+                        # the engine loop thread (like push_weights)
+                        # so the flip lands between ticks; callers
+                        # drain first — the controller's declarative
+                        # drain → reconfigure → undrain primitive
+                        role = self.engine.call_in_loop(
+                            lambda m=msg: self.engine.set_role(
+                                str(m["role"])))
+                        self._send(conn, lock, {"ok": 1, "role": role})
                     elif op == "push_weights":
                         # live weight update: chunks accumulate per
                         # connection; the last one deserializes,
@@ -728,7 +753,9 @@ class ServingClient:
     def generate(self, prompt, max_new_tokens: int, **kw) -> int:
         """Submit one request; returns its id (stream via
         :meth:`stream` / :meth:`result`; telemetry trace id via
-        :meth:`trace_of`). Pass ``trace=`` (and optionally
+        :meth:`trace_of`). Pass ``tier="batch"`` to submit into the
+        cheap QoS class (preempted first under load; default
+        ``"interactive"``). Pass ``trace=`` (and optionally
         ``parent_span=``) to propagate an existing telemetry trace id
         across the wire — the server's spans join that chain instead
         of minting a new id (how the router stitches one fleet-wide
@@ -942,6 +969,20 @@ class ServingClient:
         reply = self._call(msg)
         return {"active": int(reply.get("active", 0)),
                 "queued": int(reply.get("queued", 0))}
+
+    def reconfigure(self, role: str,
+                    replica: Optional[str] = None) -> str:
+        """Flip the server's advertised role (``"mixed"`` /
+        ``"prefill"`` / ``"decode"``) — the middle step of the fleet
+        controller's drain → reconfigure → undrain rebalancing
+        primitive. Returns the role now in effect. ``replica`` is
+        meaningful against a :class:`Router`: the named backend
+        replica is reconfigured (the router itself has no role)."""
+        msg: dict = {"op": "reconfigure", "role": str(role)}
+        if replica is not None:
+            msg["replica"] = str(replica)
+        reply = self._call(msg)
+        return str(reply["role"])
 
     def close(self):
         """Idempotent: safe to call twice, or after the connection

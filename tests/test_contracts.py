@@ -136,7 +136,8 @@ def test_protocol_extraction_matches_dispatch():
     ops = set(proto.server.arms)
     assert ops == {"generate", "stats", "metrics", "trace_dump",
                    "chrome_trace", "flight", "alerts", "drain",
-                   "export_kv", "import_kv", "push_weights"}
+                   "reconfigure", "export_kv", "import_kv",
+                   "push_weights"}
     assert set(proto.router.arms) == ops
     assert set(proto.client.ops) == ops
     assert proto.server.has_unknown_arm and proto.router.has_unknown_arm

@@ -1,0 +1,599 @@
+"""Elastic fleet controller + QoS classes: the DecisionEngine's
+no-flap law (hysteresis band, consecutive-poll streaks, cooldown)
+under deterministic injected signal timelines, Autoscaler replay
+determinism and live actuation against a real in-process fleet
+(scale-up from a warm spare, drain-and-retire scale-down, role
+rebalancing on a live replica with zero lost streams), the QoS
+scheduler's strict priority admission and batch-first prefill
+preemption, and the fleet satellite regressions: gauge merge policy
+(versions MAX, counters SUM), probe phase jitter, and probe backoff
+resetting to the healthy cadence after recovery."""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distkeras_tpu import telemetry
+from distkeras_tpu.models import get_model
+from distkeras_tpu.models.transformer import generate
+from distkeras_tpu.serving import (
+    Autoscaler,
+    DecisionEngine,
+    FIFOScheduler,
+    LMServer,
+    Request,
+    Router,
+    ServingClient,
+    ServingEngine,
+    merge_metric_snapshots,
+)
+from distkeras_tpu.serving.fleet import HEALTHY, Replica, ReplicaManager
+from distkeras_tpu.serving.scheduler import QOS_TIERS
+
+KW = dict(vocab_size=64, d_model=32, num_heads=2, num_layers=2,
+          max_len=48, dtype=jnp.float32, attention="dense")
+BS = 8
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    model = get_model("transformer_lm", **KW)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 4), jnp.int32))
+    return model, params
+
+
+def _solo(model, params, prompt, max_new):
+    out = generate(model, params, jnp.asarray(prompt)[None], max_new)
+    return np.asarray(out)[0, len(prompt):].tolist()
+
+
+def _server(model, params, slots=2):
+    eng = ServingEngine(
+        model, params, slots=slots, paged=True, block_size=BS,
+        registry=telemetry.MetricRegistry(), tracer=telemetry.Tracer(),
+    )
+    return LMServer(eng).start()
+
+
+def _router_over(servers, names=None, **kw):
+    names = names or [f"r{i}" for i in range(len(servers))]
+    base = dict(block_size=BS, poll_interval=0.05, down_after=1,
+                backoff_base=0.05, probe_timeout=2.0,
+                registry=telemetry.MetricRegistry(),
+                tracer=telemetry.Tracer())
+    base.update(kw)
+    return Router(
+        [("127.0.0.1", s.port, n) for s, n in zip(servers, names)],
+        **base,
+    ).start()
+
+
+# ---------------------------------------------------------------------------
+# DecisionEngine: the pure control law
+# ---------------------------------------------------------------------------
+
+def _sig(n=1, q=0.0, ttft=False, itl=False, recl=None, roles=None):
+    return {"replicas": n, "queue_depth": q, "ttft_burn": ttft,
+            "itl_burn": itl, "blocks_reclaimable": recl,
+            "roles": roles or {"mixed": n}}
+
+
+def test_scale_up_needs_streak_then_cooldown_gates_the_next():
+    law = DecisionEngine(max_replicas=4, queue_high=2.0, queue_low=0.5,
+                         up_consecutive=3, cooldown_s=5.0)
+    n = 1
+    acts = []
+    for t in range(30):
+        a = law.decide(_sig(n=n, q=10 * n), float(t))
+        if a:
+            acts.append((t, a["action"]))
+            n += 1
+    # first action only after 3 consecutive pressure polls; under
+    # CONSTANT pressure the streak keeps accruing through cooldown, so
+    # subsequent actions land exactly at each cooldown expiry
+    assert acts[0] == (2, "scale_up")
+    assert [a for _, a in acts] == ["scale_up"] * 3  # capped at max=4
+    assert all(t2 - t1 >= 5 for (t1, _), (t2, _) in zip(acts, acts[1:]))
+
+
+def test_hysteresis_band_and_alternation_never_act():
+    law = DecisionEngine(max_replicas=4, queue_high=4.0, queue_low=0.5,
+                         up_consecutive=2, down_consecutive=2,
+                         cooldown_s=0.0)
+    # load inside the open band (queue_low, queue_high): no streak ever
+    for t in range(50):
+        assert law.decide(_sig(n=2, q=2 * 2.0), float(t)) is None
+    # alternating pressure/idle every poll: each poll zeroes the other
+    # streak, so neither threshold (2) is ever reached — no flap
+    for t in range(50):
+        q = 100.0 if t % 2 == 0 else 0.0
+        assert law.decide(_sig(n=2, q=q), float(t + 100)) is None
+
+
+def test_scale_down_floors_at_min_replicas():
+    law = DecisionEngine(min_replicas=1, max_replicas=4,
+                         down_consecutive=2, cooldown_s=0.0)
+    n = 3
+    acts = []
+    for t in range(20):
+        a = law.decide(_sig(n=n, q=0.0), float(t))
+        if a:
+            acts.append(a["action"])
+            n -= 1
+    assert acts == ["scale_down", "scale_down"]
+    assert n == 1
+    for t in range(20, 40):  # at the floor: idle forever, no action
+        assert law.decide(_sig(n=1, q=0.0), float(t)) is None
+
+
+def test_rebalance_decisions_and_guards():
+    # at max capacity with a TTFT burn: flip a mixed replica to
+    # prefill — but only with >= 2 mixed spares and none already there
+    law = DecisionEngine(max_replicas=3, up_consecutive=2,
+                         cooldown_s=0.0)
+    roles = {"mixed": 3, "prefill": 0, "decode": 0}
+    assert law.decide(_sig(n=3, ttft=True, roles=roles), 0.0) is None
+    a = law.decide(_sig(n=3, ttft=True, roles=roles), 1.0)
+    assert a == {"action": "rebalance", "role": "prefill",
+                 "reason": "ttft_burn"}
+    # ITL burn -> decode
+    law = DecisionEngine(max_replicas=3, up_consecutive=1,
+                         cooldown_s=0.0)
+    a = law.decide(_sig(n=3, itl=True, roles=roles), 0.0)
+    assert a == {"action": "rebalance", "role": "decode",
+                 "reason": "itl_burn"}
+    # guard: a prefill replica already exists -> hold
+    law = DecisionEngine(max_replicas=3, up_consecutive=1,
+                         cooldown_s=0.0)
+    have = {"mixed": 2, "prefill": 1, "decode": 0}
+    assert law.decide(_sig(n=3, ttft=True, roles=have), 0.0) is None
+    # guard: < 2 mixed spares -> hold (never specialize away all
+    # general capacity); below max it grows instead of specializing
+    law = DecisionEngine(max_replicas=3, up_consecutive=1,
+                         cooldown_s=0.0)
+    thin = {"mixed": 1, "prefill": 1, "decode": 1}
+    assert law.decide(_sig(n=3, ttft=True, roles=thin), 0.0) is None
+    law = DecisionEngine(max_replicas=4, up_consecutive=1,
+                         cooldown_s=0.0)
+    a = law.decide(_sig(n=3, ttft=True, roles=roles), 0.0)
+    assert a["action"] == "scale_up" and a["reason"] == "slo_burn"
+
+
+def test_law_is_deterministic_over_a_seeded_timeline():
+    rng = np.random.default_rng(3)
+    timeline = [(float(t), _sig(n=int(rng.integers(1, 5)),
+                                q=float(rng.uniform(0, 20)),
+                                ttft=bool(rng.random() < 0.1)))
+                for t in range(200)]
+    runs = []
+    for _ in range(2):
+        law = DecisionEngine(max_replicas=4, cooldown_s=3.0)
+        runs.append([(t, law.decide(s, t)) for t, s in timeline])
+    assert runs[0] == runs[1]
+    assert any(a for _, a in runs[0])  # the timeline does decide things
+
+
+def test_law_validation():
+    with pytest.raises(ValueError):
+        DecisionEngine(queue_low=4.0, queue_high=4.0)  # empty band
+    with pytest.raises(ValueError):
+        DecisionEngine(min_replicas=0)
+    with pytest.raises(ValueError):
+        DecisionEngine(min_replicas=3, max_replicas=2)
+    with pytest.raises(ValueError):
+        DecisionEngine(up_consecutive=0)
+
+
+# ---------------------------------------------------------------------------
+# QoS scheduler: strict priority + batch-first preemption
+# ---------------------------------------------------------------------------
+
+def _req(n_prompt=8, tier="interactive", **kw):
+    return Request(prompt=np.zeros(n_prompt, np.int32),
+                   max_new_tokens=4, tier=tier, **kw)
+
+
+def test_qos_admission_strict_priority_then_fifo():
+    s = FIFOScheduler(registry=telemetry.MetricRegistry(),
+                      tracer=telemetry.Tracer())
+    b1, i1, b2, i2 = (_req(tier="batch"), _req(), _req(tier="batch"),
+                      _req())
+    for r in (b1, i1, b2, i2):
+        s.submit(r)
+    assert s.depth() == 4
+    assert s.depth_by_tier() == {"interactive": 2, "batch": 2}
+    admitted, expired = s.pop_admissible(4)
+    assert not expired
+    # every interactive request before any batch one; FIFO within tier
+    assert [r.rid for r in admitted] == [i1.rid, i2.rid, b1.rid, b2.rid]
+
+
+def test_qos_blocked_interactive_head_blocks_batch_too():
+    s = FIFOScheduler(registry=telemetry.MetricRegistry(),
+                      tracer=telemetry.Tracer())
+    i1, b1 = _req(), _req(tier="batch")
+    s.submit(i1)
+    s.submit(b1)
+    # the interactive head fails the resource gate: batch must NOT
+    # queue-jump past it (it would steal the blocks the head waits on)
+    admitted, _ = s.pop_admissible(
+        2, admissible=lambda r: r.tier != "interactive")
+    assert admitted == []
+    assert s.depth() == 2
+
+
+def test_qos_plan_prefill_preempts_batch_first():
+    s = FIFOScheduler(tick_token_budget=40,
+                      registry=telemetry.MetricRegistry(),
+                      tracer=telemetry.Tracer())
+    # batch slot sits at index 0, interactive at 1: the budget is
+    # dealt to interactive FIRST regardless of slot order, batch gets
+    # the remainder and its truncation is counted as a preemption
+    out = s.plan_prefill(0, [64, 64], 32, tiers=["batch", "interactive"])
+    assert out == [8, 32]
+    assert s._m_qos_preempted.labels(tier="batch").value == 1
+    assert s._m_qos_preempted.labels(tier="interactive").value == 0
+    # legacy path (tiers=None): index order, no preemption accounting
+    s2 = FIFOScheduler(tick_token_budget=40,
+                       registry=telemetry.MetricRegistry(),
+                       tracer=telemetry.Tracer())
+    assert s2.plan_prefill(0, [64, 64], 32) == [32, 8]
+    assert s2._m_qos_preempted.labels(tier="batch").value == 0
+
+
+def test_qos_all_interactive_matches_legacy_order():
+    a = FIFOScheduler(tick_token_budget=50,
+                      registry=telemetry.MetricRegistry(),
+                      tracer=telemetry.Tracer())
+    b = FIFOScheduler(tick_token_budget=50,
+                      registry=telemetry.MetricRegistry(),
+                      tracer=telemetry.Tracer())
+    lens = [40, 16, 64]
+    assert (a.plan_prefill(4, lens, 32,
+                           tiers=["interactive"] * 3)
+            == b.plan_prefill(4, lens, 32))
+
+
+def test_qos_tier_validation_and_depth_gauges():
+    s = FIFOScheduler(registry=telemetry.MetricRegistry(),
+                      tracer=telemetry.Tracer())
+    with pytest.raises(ValueError):
+        s.submit(_req(tier="platinum"))
+    s.submit(_req(tier="batch"))
+    depth = s.registry.gauge("serving_qos_queue_depth",
+                             labelnames=("tier",))
+    assert depth.labels(tier="batch").value == 1
+    assert depth.labels(tier="interactive").value == 0
+    assert tuple(QOS_TIERS) == ("interactive", "batch")
+
+
+def test_engine_threads_tier_to_qos_stats(model_and_params):
+    model, params = model_and_params
+    eng = ServingEngine(model, params, slots=2, paged=True,
+                        block_size=BS,
+                        registry=telemetry.MetricRegistry(),
+                        tracer=telemetry.Tracer())
+    stop = threading.Event()
+    thread = threading.Thread(target=eng.serve_forever, args=(stop,),
+                              daemon=True)
+    thread.start()
+    try:
+        prompt = np.arange(8, dtype=np.int32) % KW["vocab_size"]
+        ri = eng.submit(prompt, max_new_tokens=4)
+        rb = eng.submit(prompt, max_new_tokens=4, tier="batch")
+        for r in (ri, rb):
+            r.stream.tokens(timeout=60)
+        st = eng.stats()
+        assert set(st["qos"]) == set(QOS_TIERS)
+        for t in QOS_TIERS:
+            assert st["qos"][t]["queue_depth"] == 0
+        # per-tier latency histograms observed for both tiers
+        itl = eng.registry.histogram("serving_qos_ttft_ms",
+                                     labelnames=("tier",))
+        assert itl.labels(tier="interactive").value["count"] == 1
+        assert itl.labels(tier="batch").value["count"] == 1
+        with pytest.raises(ValueError):
+            eng.submit(prompt, max_new_tokens=4, tier="gold")
+    finally:
+        stop.set()
+        thread.join(timeout=10)
+
+
+# ---------------------------------------------------------------------------
+# fleet satellites: merge policy, probe jitter, backoff recovery
+# ---------------------------------------------------------------------------
+
+def test_merge_policy_version_gauges_max_counters_sum():
+    """Regression: summing every gauge made the fleet 'weight_version'
+    read 3+5=8 after a rolling update — versions (and up/alert flags)
+    must merge as MAX while counters keep summing."""
+    a = telemetry.MetricRegistry()
+    b = telemetry.MetricRegistry()
+    a.gauge("serving_weight_version").set(3)
+    b.gauge("serving_weight_version").set(5)
+    a.gauge("slo_alert_active", labelnames=("rule",)).labels(
+        rule="itl_p99_ms").set(1)
+    b.gauge("slo_alert_active", labelnames=("rule",)).labels(
+        rule="itl_p99_ms").set(0)
+    a.gauge("serving_queue_depth").set(2)   # capacity gauge: sums
+    b.gauge("serving_queue_depth").set(3)
+    a.counter("serving_requests_total").inc(7)
+    b.counter("serving_requests_total").inc(11)
+    m = merge_metric_snapshots([a.collect(), b.collect()])
+    assert m["serving_weight_version"]["series"][0]["value"] == 5
+    assert m["slo_alert_active"]["series"][0]["value"] == 1
+    assert m["serving_queue_depth"]["series"][0]["value"] == 5
+    assert m["serving_requests_total"]["series"][0]["value"] == 18
+
+
+def test_aggregate_stats_takes_max_of_weight_version():
+    r1 = Replica("127.0.0.1", 1, "a")
+    r2 = Replica("127.0.0.1", 2, "b")
+    mgr = ReplicaManager([r1, r2],
+                         registry=telemetry.MetricRegistry())
+    r1.last_stats = {"weight_version": 3, "requests_completed": 4}
+    r2.last_stats = {"weight_version": 5, "requests_completed": 6}
+    fleet = mgr.aggregate_stats()["fleet"]
+    assert fleet["weight_version"] == 5       # max, not 8
+    assert fleet["requests_completed"] == 10  # counters still sum
+
+
+def test_probe_phase_jitter_spreads_replicas():
+    """Regression: N replicas probed back-to-back in one loop pass
+    stampede the fleet every poll_interval. Each replica now owns a
+    stable phase offset inside the interval."""
+    replicas = [Replica("127.0.0.1", 1000 + i, f"r{i}")
+                for i in range(8)]
+    mgr = ReplicaManager(replicas, poll_interval=1.0,
+                         registry=telemetry.MetricRegistry())
+    phases = [mgr._phase(r.name) for r in replicas]
+    assert all(0.0 <= p < 1.0 for p in phases)
+    assert len(set(phases)) == len(phases)            # spread out
+    assert phases == [mgr._phase(r.name) for r in replicas]  # stable
+
+
+def test_probe_backoff_resets_to_healthy_cadence(model_and_params):
+    model, params = model_and_params
+    srv = _server(model, params)
+    replica = Replica("127.0.0.1", srv.port, "r0")
+    mgr = ReplicaManager([replica], poll_interval=0.05,
+                         probe_timeout=2.0, down_after=1,
+                         backoff_base=0.05,
+                         registry=telemetry.MetricRegistry())
+    try:
+        mgr.probe(replica)
+        assert replica.state == HEALTHY
+        # simulate an outage's accumulated backoff state, then recover:
+        # one good probe must restore the healthy cadence (no lingering
+        # backoff slowing the next failure detection)
+        replica.failures = 4
+        replica.backoff_s = 1.6
+        replica.next_attempt_t = time.monotonic() - 1.0
+        mgr.probe(replica)
+        assert replica.state == HEALTHY
+        assert replica.failures == 0
+        assert replica.backoff_s == 0.0
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# live fleet: drain cycling, role flips, the Autoscaler end to end
+# ---------------------------------------------------------------------------
+
+def test_drain_undrain_drain_cycle_forgets_affinity_each_time(
+        model_and_params):
+    """Satellite: repeated drain -> undrain -> drain on a live replica.
+    Admissions close and reopen each cycle, and the router forgets the
+    replica's affinity placements on EVERY drain, not just the first."""
+    model, params = model_and_params
+    servers = [_server(model, params) for _ in range(2)]
+    router = _router_over(servers)
+    client = ServingClient("127.0.0.1", router.port,
+                           request_timeout=60.0)
+    try:
+        prefix = (np.arange(2 * BS, dtype=np.int32)
+                  % KW["vocab_size"])
+
+        def route_of():
+            tail = np.array([1, 2], np.int32)
+            rid = client.generate(np.concatenate([prefix, tail]),
+                                  max_new_tokens=2)
+            client.result(rid, timeout=60)
+
+        full = np.concatenate([prefix, np.array([1, 2], np.int32)])
+        route_of()
+        with router._route_lock:
+            owner, hit = router.index.lookup(full)
+        assert owner in ("r0", "r1") and hit > 0
+        for _ in range(2):  # the cycle, twice
+            client.drain(replica=owner)
+            with router._route_lock:  # forgotten IMMEDIATELY
+                assert router.index.lookup(full)[0] is None
+            client.undrain(replica=owner)
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                router.manager.probe_all()
+                if any(r.name == owner and r.state == HEALTHY
+                       for r in router.manager.routable()):
+                    break
+                time.sleep(0.02)
+            route_of()  # re-learn some placement post-undrain
+            with router._route_lock:
+                owner, hit = router.index.lookup(full)
+            assert owner is not None and hit > 0
+    finally:
+        client.close()
+        router.stop()
+        for s in servers:
+            s.stop()
+
+
+def test_live_role_flip_zero_lost_streams(model_and_params):
+    """Satellite: reconfigure a live replica's role through the wire
+    (drain -> reconfigure -> undrain) while streams are in flight —
+    every stream completes with solo-generate parity, and the new role
+    is visible in stats."""
+    model, params = model_and_params
+    servers = [_server(model, params) for _ in range(3)]
+    router = _router_over(servers)
+    client = ServingClient("127.0.0.1", router.port,
+                           request_timeout=60.0)
+    try:
+        rng = np.random.default_rng(5)
+        prompts = [rng.integers(0, KW["vocab_size"], size=8
+                                ).astype(np.int32) for _ in range(9)]
+        rids = [client.generate(p, max_new_tokens=8) for p in prompts]
+        # flip r2 mid-flight: the drain half waits for its accepted
+        # streams, so nothing is lost by construction
+        client.drain(replica="r2")
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            snap = client.stats()["replicas"]["r2"]["stats"]
+            if snap.get("drained"):
+                break
+            time.sleep(0.02)
+        assert client.reconfigure("prefill", replica="r2") == "prefill"
+        client.undrain(replica="r2")
+        for p, rid in zip(prompts, rids):
+            toks, reason = client.result(rid, timeout=60)
+            assert reason == "length"
+            assert toks == _solo(model, params, p, 8)
+        assert servers[2].engine.role == "prefill"
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if (client.stats()["replicas"]["r2"]["stats"].get("role")
+                    == "prefill"):
+                break
+            time.sleep(0.05)
+        assert (client.stats()["replicas"]["r2"]["stats"]["role"]
+                == "prefill")
+        # direct (non-router) reconfigure validates its input
+        direct = ServingClient("127.0.0.1", servers[0].port)
+        with pytest.raises(RuntimeError):
+            direct.reconfigure("sorter")
+        direct.close()
+    finally:
+        client.close()
+        router.stop()
+        for s in servers:
+            s.stop()
+
+
+def test_autoscaler_live_scale_up_down_and_replay(model_and_params):
+    """The controller end to end against a real fleet, stepped
+    manually with injected clocks: queue pressure scales up from the
+    warm spare, idleness drains and retires back down, the event
+    sequence is monotone, and replaying the recorded signal log
+    through a fresh law reproduces the live decisions exactly."""
+    model, params = model_and_params
+    active = _server(model, params, slots=1)
+    spare = _server(model, params, slots=1)
+    router = _router_over([active], names=["r0"])
+    client = ServingClient("127.0.0.1", router.port,
+                           request_timeout=60.0)
+    retired = []
+
+    def spawn():
+        spare.engine.end_drain()
+        return ("127.0.0.1", spare.port, "r1")
+
+    auto = Autoscaler(router, spawn=spawn, retire=retired.append,
+                      registry=telemetry.MetricRegistry(),
+                      tracer=telemetry.Tracer(),
+                      min_replicas=1, max_replicas=2,
+                      queue_high=2.0, queue_low=0.5,
+                      up_consecutive=2, down_consecutive=2,
+                      cooldown_s=0.5, rebalance=False)
+    try:
+        rng = np.random.default_rng(9)
+        prompts = [rng.integers(0, KW["vocab_size"], size=8
+                                ).astype(np.int32) for _ in range(6)]
+        rids = [client.generate(p, max_new_tokens=12)
+                for p in prompts]
+        # queued work on 1 slot -> sustained pressure
+        now, acted = 0.0, None
+        for _ in range(20):
+            router.manager.probe_all()
+            acted = auto.step(now=now)
+            now += 1.0
+            if acted:
+                break
+        assert acted and acted["action"] == "scale_up"
+        assert acted["ok"], acted
+        assert {r.name for r in router.manager.routable()} == \
+            {"r0", "r1"}
+        for p, rid in zip(prompts, rids):
+            toks, reason = client.result(rid, timeout=60)
+            assert reason == "length"
+            assert toks == _solo(model, params, p, 12)
+        # idle fleet -> scale back down to min
+        acted = None
+        for _ in range(20):
+            router.manager.probe_all()
+            acted = auto.step(now=now)
+            now += 1.0
+            if acted:
+                break
+        assert acted and acted["action"] == "scale_down"
+        assert acted["ok"], acted
+        assert len(router.manager.routable()) == 1
+        assert retired  # the drained victim was handed to retire()
+        # monotone sequence + exact replay of the recorded timeline
+        kinds = [e["action"] for e in auto.events]
+        assert kinds == ["scale_up", "scale_down"]
+        assert auto.replay() == auto.decisions()
+    finally:
+        client.close()
+        router.stop()
+        for s in (active, spare):
+            try:
+                s.stop()
+            except Exception:
+                pass
+
+
+def test_autoscaler_rebalance_actuation_live(model_and_params):
+    """The rebalance actuator against a live 3-replica fleet: drain
+    the least-loaded mixed replica, flip its role over the wire,
+    undrain it — and in-flight streams on the fleet survive."""
+    model, params = model_and_params
+    servers = [_server(model, params) for _ in range(3)]
+    router = _router_over(servers)
+    client = ServingClient("127.0.0.1", router.port,
+                           request_timeout=60.0)
+    auto = Autoscaler(router, registry=telemetry.MetricRegistry(),
+                      tracer=telemetry.Tracer(), max_replicas=3)
+    try:
+        rng = np.random.default_rng(13)
+        prompts = [rng.integers(0, KW["vocab_size"], size=8
+                                ).astype(np.int32) for _ in range(6)]
+        rids = [client.generate(p, max_new_tokens=8) for p in prompts]
+        router.manager.probe_all()
+        action = {"action": "rebalance", "role": "decode"}
+        auto._actuate(action)
+        victim = action["replica"]
+        for p, rid in zip(prompts, rids):
+            toks, reason = client.result(rid, timeout=60)
+            assert reason == "length"
+            assert toks == _solo(model, params, p, 8)
+        router.manager.probe_all()
+        roles = {r.name: r.role for r in router.manager.routable()}
+        assert roles[victim] == "decode"
+        assert sorted(roles.values()) == ["decode", "mixed", "mixed"]
+        # guard: a second flip would leave < 2 mixed spares... still
+        # fine (2 mixed); a third must refuse
+        auto._actuate({"action": "rebalance", "role": "prefill"})
+        router.manager.probe_all()
+        with pytest.raises(RuntimeError, match="fewer than 2 mixed"):
+            auto._actuate({"action": "rebalance", "role": "prefill"})
+    finally:
+        client.close()
+        router.stop()
+        for s in servers:
+            s.stop()
