@@ -316,6 +316,7 @@ def main():
     out.update(serve_speculative_bench())
     out.update(serve_router_bench())
     out.update(serve_pipeline_bench())
+    out.update(serve_multistep_bench())
     out.update(serve_tier_bench())
     out.update(serve_disagg_bench())
     out.update(serve_update_bench())
@@ -510,6 +511,41 @@ def serve_pipeline_bench():
         }
     except Exception as e:  # pragma: no cover - accelerator-dependent
         return {"serve_pipe_error": f"{type(e).__name__}: {e}"}
+
+
+def serve_multistep_bench():
+    """Multi-step-decode numbers for the BENCH trajectory: decode
+    tok/s vs window width k (the per-dispatch amortization sweep), the
+    best k with its speedup over k=1, dispatch counts, and the ITL p99
+    comparison that proves the per-token attribution. Self-asserts are
+    off (``checks=False``) and errors are folded into the JSON, same
+    policy as the other serving lines."""
+    import os
+    import sys
+
+    sys.path.insert(
+        0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "benchmarks"))
+    try:
+        import serve_bench
+
+        r = serve_bench.bench_multistep(smoke=True, checks=False)
+        out = {k: v for k, v in r.items()
+               if k.startswith(("tok_s_k", "itl_p99_ms_k",
+                                "dispatches_k"))}
+        out = {f"serve_multistep_{k}": v for k, v in out.items()}
+        out.update({
+            "serve_multistep_best_k": r["best_k"],
+            "serve_multistep_speedup_best": r["speedup_best"],
+            "serve_multistep_paged_tok_s_best": r["paged_tok_s_best"],
+            "serve_multistep_tokens_per_dispatch_p50":
+                r["tokens_per_dispatch_p50_best"],
+            "serve_multistep_parity": r["parity"],
+            "serve_multistep_config": r["config"],
+        })
+        return out
+    except Exception as e:  # pragma: no cover - accelerator-dependent
+        return {"serve_multistep_error": f"{type(e).__name__}: {e}"}
 
 
 def serve_interference_bench():

@@ -570,10 +570,40 @@ def config17():
     }))
 
 
+def config18():
+    """Device-resident multi-step decode: the k-step window sweep
+    (benchmarks/serve_bench.py --multi-step; the --smoke variant
+    self-asserts bit-identical streams at every k incl. the paged leg,
+    zero steady-state recompiles in every measured arm, strictly fewer
+    dispatches at the best k, tok/s monotonic-or-flat k=1→4 with
+    >=1.3x at the best k, and ITL p99 no worse than k=1)."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import serve_bench
+
+    out = serve_bench.bench_multistep(smoke=True)
+    kb = out["best_k"]
+    print(json.dumps({
+        "config": 18, "metric": "serving_multistep_speedup_best",
+        "value": out["speedup_best"],
+        "unit": f"x (decode tok/s at best k={kb} / k=1)",
+        "tok_s_k1": out["tok_s_k1"],
+        "tok_s_best": out[f"tok_s_k{kb}"],
+        "paged_tok_s_best": out["paged_tok_s_best"],
+        "dispatches_k1": out["dispatches_k1"],
+        "dispatches_best": out[f"dispatches_k{kb}"],
+        "tokens_per_dispatch_p50": out["tokens_per_dispatch_p50_best"],
+        "itl_p99_ms_k1": out["itl_p99_ms_k1"],
+        "itl_p99_ms_best": out[f"itl_p99_ms_k{kb}"],
+        "parity": out["parity"],
+        "model": out["config"],
+        "data": "synthetic-multistep-drain-trace",
+    }))
+
+
 CONFIGS = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5,
            6: config6, 7: config7, 8: config8, 9: config9, 10: config10,
            11: config11, 12: config12, 13: config13, 14: config14,
-           15: config15, 16: config16, 17: config17}
+           15: config15, 16: config16, 17: config17, 18: config18}
 
 
 def main():

@@ -418,6 +418,131 @@ def _paged_mixed_tick_fn(dm_paged, cfgs, chunk,
     return tick
 
 
+# -- device-resident multi-step decode (k tokens per dispatch) ---------------
+#
+# When every occupied slot is DECODING (no chunk dealt, no restores in
+# flight, no speculative window, no staged control call), the per-token
+# cost of the engine is one host->device dispatch plus one
+# device->host readback — the tick body itself is tiny on small models.
+# The multi-step tick runs k of those steps inside ONE dispatch via
+# lax.scan over the exact k=1 body: per step it samples each row from
+# the carried last-token logits (same RNG split, same [1, vocab] call
+# shape as _tick_fn — streams stay bit-identical), feeds the sampled
+# token with a per-row valid length, and detects EOS / budget
+# exhaustion ON DEVICE so stopped rows go quiet (valid 0: no KV write,
+# no cursor advance, RNG chain untouched) for the window's remainder.
+# The host reads back [S, k] tokens plus per-row emitted counts and
+# trims the unread tail exactly like the pipelined loop's late-EOS
+# path. A row's post-stop state is unobservable by construction: the
+# stop reason that froze it also completes the request at reconcile,
+# and admission reseeds the slot's RNG and resets its cursor.
+
+
+@functools.lru_cache(maxsize=256)
+def _multi_tick_fn(dm_slot, cfgs, k, ctx: Optional[_ShardCtx] = None):
+    """Compiled k-step decode window, slot mode: ``lax.scan`` over the
+    :func:`_tick_fn` body. The packed control buffer carries per-row
+    EOS ids (-1 = none) and emission limits ``lim = min(k, remaining)``
+    (0 = idle row); a row is ALIVE while it has neither hit its EOS nor
+    emitted ``lim`` tokens. Alive rows advance exactly as k consecutive
+    k=1 ticks would — the EOS token itself is fed in its own step, as
+    the sync loop feeds it in its own tick — and stopped rows run
+    valid-0 padding. Returns ``[S, k]`` tokens (column-major per step;
+    garbage past each row's count, never read) and the per-row counts
+    the reconcile trims by."""
+
+    @functools.partial(_compile, ctx=ctx, in_kinds="pcrrr",
+                       out_kinds="crrrr", donate=(1, 2, 3))
+    def tick(params_only, cache, last_logits, rngs, packed):
+        recompiles.note("serve.multi_tick")
+        S = rngs.shape[0]
+        eos, lim = _unpack_i32(packed, ((S,), (S,)))
+
+        def step(carry, _):
+            cache, last, rngs, stopped, emitted = carry
+            alive = ~stopped & (emitted < lim)
+            toks, new_rngs = [], []
+            for s, (temp, top_k, top_p) in enumerate(cfgs):
+                rng, sub = jax.random.split(rngs[s])
+                toks.append(
+                    sample_tokens(last[s][None], sub, temp,
+                                  top_k, top_p)[0]
+                )
+                new_rngs.append(jnp.where(alive[s], rng, rngs[s]))
+            tok = jnp.stack(toks)  # [S]
+            valid = alive.astype(jnp.int32)
+            logits, vs = dm_slot.apply(
+                {**params_only, "cache": cache}, tok[:, None],
+                valid_lens=valid, mutable=["cache"],
+            )
+            last = jnp.where(alive[:, None], logits[:, -1], last)
+            stopped = stopped | (alive & (eos >= 0) & (tok == eos))
+            return ((vs["cache"], last, jnp.stack(new_rngs), stopped,
+                     emitted + valid), tok)
+
+        init = (cache, last_logits, rngs,
+                jnp.zeros((S,), bool), jnp.zeros((S,), jnp.int32))
+        (cache, last, rngs, _, counts), toks = jax.lax.scan(
+            step, init, None, length=k)
+        return cache, last, toks.T, counts, rngs
+
+    return tick
+
+
+@functools.lru_cache(maxsize=256)
+def _paged_multi_tick_fn(dm_paged, cfgs, k,
+                         ctx: Optional[_ShardCtx] = None):
+    """Paged twin of :func:`_multi_tick_fn`: the packed transfer adds
+    block tables and WINDOW-START seq lens; each step writes alive rows
+    at absolute position ``lens + emitted`` (the device-side mirror of
+    the host cursor advance the k=1 paged tick does per dispatch).
+    Stopped rows steer their write to the reserved trash block via
+    valid 0 and do not advance. The host preallocated the worst case at
+    admission (``_blocks_for`` covers prompt + max_new), so a window
+    never allocates; writes past a trimmed row's chain land in the
+    trash block (its table is zero beyond the chain)."""
+
+    @functools.partial(_compile, ctx=ctx, in_kinds="pcrrr",
+                       out_kinds="crrrr", donate=(1, 2, 3))
+    def tick(params_only, cache, last_logits, rngs, packed):
+        recompiles.note("serve.paged_multi_tick")
+        S = rngs.shape[0]
+        MB = packed.shape[0] // S - 3
+        tables, lens, eos, lim = _unpack_i32(
+            packed, ((S, MB), (S,), (S,), (S,)))
+
+        def step(carry, _):
+            cache, last, rngs, stopped, emitted = carry
+            alive = ~stopped & (emitted < lim)
+            toks, new_rngs = [], []
+            for s, (temp, top_k, top_p) in enumerate(cfgs):
+                rng, sub = jax.random.split(rngs[s])
+                toks.append(
+                    sample_tokens(last[s][None], sub, temp,
+                                  top_k, top_p)[0]
+                )
+                new_rngs.append(jnp.where(alive[s], rng, rngs[s]))
+            tok = jnp.stack(toks)  # [S]
+            valid = alive.astype(jnp.int32)
+            logits, vs = dm_paged.apply(
+                {**params_only, "cache": cache}, tok[:, None],
+                block_tables=tables, seq_lens=lens + emitted,
+                valid_lens=valid, mutable=["cache"],
+            )
+            last = jnp.where(alive[:, None], logits[:, -1], last)
+            stopped = stopped | (alive & (eos >= 0) & (tok == eos))
+            return ((vs["cache"], last, jnp.stack(new_rngs), stopped,
+                     emitted + valid), tok)
+
+        init = (cache, last_logits, rngs,
+                jnp.zeros((S,), bool), jnp.zeros((S,), jnp.int32))
+        (cache, last, rngs, _, counts), toks = jax.lax.scan(
+            step, init, None, length=k)
+        return cache, last, toks.T, counts, rngs
+
+    return tick
+
+
 # -- speculative decoding (draft-assisted verify ticks) ----------------------
 #
 # A speculative tick generalizes the mixed tick's per-row roles into one
@@ -902,7 +1027,7 @@ class _InflightTick:
     record that outlives the step (the donation-safety pass checks
     this handoff)."""
 
-    toks: Any                       # device [S] (or [S, k+1] spec)
+    toks: Any                       # device [S] ([S, k+1] spec, [S, k] multi)
     # per slot: None (idle at plan) | ("dec", st) | ("pre", st, take,
     # flipped) — flipped marks the prompt's last chunk landing
     rows: List[Optional[tuple]]
@@ -911,6 +1036,10 @@ class _InflightTick:
     n_dec: int
     fed_tokens: int
     chunk: Optional[int]
+    # multi-step decode: the window width this record dispatched (None
+    # = ordinary one-token tick); ``acc`` doubles as its device [S]
+    # per-row emitted counts
+    multi_k: Optional[int] = None
     # speculative extras (depth-1 pipeline: emissions defer, plans don't)
     acc: Any = None                 # device [S] accepted-prefix lengths
     n_forced: Optional[np.ndarray] = None
@@ -1081,6 +1210,25 @@ class ServingEngine:
         independently. Default: the process's first local device.
         Mutually exclusive with ``mesh`` (a tensor-parallel engine
         spans its mesh's devices).
+      multi_step_k: device-resident multi-step decode. When the engine
+        is in ALL-DECODE steady state (every occupied slot decoding;
+        no prompt chunk dealt, no host-tier restore queued or in
+        flight, no staged control call, no speculative window), run up
+        to ``multi_step_k`` decode steps inside ONE dispatch — a
+        ``lax.scan`` over the exact k=1 tick body, with sampling,
+        KV-cache writes, and EOS detection on device — cutting
+        host↔device round trips per token by k×, the same
+        amortization solo :meth:`TransformerLM.generate` gets from its
+        own scan loop. RNG chains advance once per emitted token and
+        a row that hits EOS or its length budget mid-window goes
+        quiet on device (no write, no cursor advance, chain frozen),
+        so every stream stays bit-identical to the k=1 reference on
+        both cache layouts, sync or pipelined, single-chip or TP.
+        The moment any non-steady-state condition appears the engine
+        falls back to ordinary one-token ticks for that step (counted
+        per reason in ``serving_multi_step_fallbacks_total``) — and
+        because k is fixed, steady state never recompiles. Default 1:
+        fast path off.
 
     Drive it with :meth:`step` (one admit→tick→complete→refill cycle,
     e.g. from a test) or :meth:`serve_forever` (the TCP front-end's
@@ -1106,7 +1254,8 @@ class ServingEngine:
                  prefill_kernel: str = "auto",
                  draft=None, draft_params=None, spec_k: int = 4,
                  ngram_max: int = 3, device=None,
-                 pipeline: bool = False, role: str = "mixed"):
+                 pipeline: bool = False, role: str = "mixed",
+                 multi_step_k: int = 1):
         if slots < 1:
             raise ValueError(f"slots must be >= 1; got {slots}")
         if role not in ("mixed", "prefill", "decode"):
@@ -1128,6 +1277,21 @@ class ServingEngine:
                 f"prefill); got {prefill_chunk}"
             )
         self.prefill_chunk = prefill_chunk
+        # device-resident multi-step decode: in all-decode steady state
+        # the engine runs up to multi_step_k decode steps per dispatch
+        # (one lax.scan window) and falls back to ordinary one-token
+        # ticks the moment any non-steady-state condition appears —
+        # chunk dealt, restore in flight, staged control call,
+        # speculative window. 1 (the default) disables the fast path.
+        if multi_step_k < 1:
+            raise ValueError(
+                f"multi_step_k must be >= 1; got {multi_step_k}"
+            )
+        self.multi_step_k = multi_step_k
+        # host-side fallback accounting by reason (the registry counter
+        # serving_multi_step_fallbacks_total is the labeled twin)
+        self.multi_step_fallbacks: dict = {}
+        self.dispatches = 0
         self._admit_seq = 0
         # pipelined loop: dispatched-but-unread ticks (at most one in
         # steady state), the packed-control-buffer reuse cache (an
@@ -1684,6 +1848,28 @@ class ServingEngine:
             "serving_qos_critical_path_ms",
             "per-request critical-path attribution by QoS tier (ms)",
             labelnames=("tier", "phase"))
+        # device-resident multi-step decode (PR 19): dispatch-level
+        # accounting. tokens/dispatch is the amortization the k-step
+        # window buys (a flat 1 means multi-step is off or the engine
+        # never reaches all-decode steady state); the fallback counter
+        # says WHY windows are not being granted
+        self._m_dispatches = reg.counter(
+            "serving_dispatches_total",
+            "tick dispatches (a k-step multi window counts once)")
+        self._m_tokens_per_dispatch = reg.histogram(
+            "serving_tokens_per_dispatch",
+            "tokens emitted per tick dispatch (multi-step windows "
+            "amortize the host round trip over up to k tokens)",
+            buckets=(0, 1, 2, 3, 4, 6, 8, 12, 16, 24, 32))
+        self._m_multi_k = reg.gauge(
+            "serving_multi_step_k",
+            "window width of the latest reconciled dispatch (1 = "
+            "ordinary tick: multi-step off or fallen back)")
+        self._m_multi_fallbacks = reg.counter(
+            "serving_multi_step_fallbacks_total",
+            "planned ticks that fell back to k=1, by the "
+            "non-steady-state condition that forced it",
+            labelnames=("reason",))
         # live weight updates (the train→serve loop): the currently
         # served weight version, swap count, and how long each atomic
         # hot swap took (validation + staged device upload + rebind)
@@ -1800,7 +1986,10 @@ class ServingEngine:
         n_prefills = self._admit()
         occupied = any(st is not None for st in self._slots)
         if occupied:
-            if self.spec:
+            k = self._multi_gate()
+            if k > 1:
+                self._reconcile(self._plan_dispatch_multi(k))
+            elif self.spec:
                 self._spec_tick()
             elif self.prefill_chunk is not None:
                 self._mixed_tick()
@@ -1839,6 +2028,7 @@ class ServingEngine:
             self._admit()
             occupied = any(st is not None for st in self._slots)
             if occupied:
+                self._multi_gate()  # fallback accounting only ("spec")
                 self._pending.append(self._plan_dispatch_spec())
             self._flush_emissions(defer)
             return (occupied or self.scheduler.depth() > 0
@@ -1846,9 +2036,13 @@ class ServingEngine:
         self._admit()
         occupied = any(st is not None for st in self._slots)
         if occupied:
-            rec = (self._plan_dispatch_mixed()
-                   if self.prefill_chunk is not None
-                   else self._plan_dispatch_decode())
+            k = self._multi_gate()
+            if k > 1:
+                rec = self._plan_dispatch_multi(k)
+            elif self.prefill_chunk is not None:
+                rec = self._plan_dispatch_mixed()
+            else:
+                rec = self._plan_dispatch_decode()
             self._pending.append(rec)
         # keep exactly one tick unreconciled while occupied (the
         # pipeline depth); flush everything once the pool idles so the
@@ -2751,12 +2945,23 @@ class ServingEngine:
         snapshot."""
         t_wait0 = time.perf_counter()
         toks_host = np.asarray(rec.toks)  # forces completion of the tick
+        counts_host = (np.asarray(rec.acc) if rec.multi_k is not None
+                       else None)
         wait_ms = (time.perf_counter() - t_wait0) * 1e3
         t_stream0 = time.perf_counter()
         self.ticks += 1
         occupancy = sum(st is not None for st in self._slots)
         self._occ_sum += occupancy
         now = time.monotonic()
+        device_ms = rec.dispatch_ms + wait_ms
+        k = rec.multi_k or 1
+        # multi-step windows: one readback carries up to k tokens per
+        # row, each produced one scan step apart — attribute per-token
+        # timestamps across the window's device span so the per-tier
+        # ITL histograms see k gaps of ~device_ms/k, not one lump and
+        # k-1 zeros (no k-wide ITL spikes in the QoS stats)
+        step_s = (device_ms / 1e3) / k
+        window_t0 = now - (k - 1) * step_s
         emitted = 0
         overrun = 0
         for s, row in enumerate(rec.rows):
@@ -2771,7 +2976,8 @@ class ServingEngine:
                 # parity holds because the chain died with the request
                 # (the refill reseeds the slot's key).
                 if row[0] == "dec":
-                    overrun += 1
+                    overrun += (1 if counts_host is None
+                                else int(counts_host[s]))
                 continue
             if row[0] == "pre":
                 if row[3]:  # the prompt's last chunk landed this tick
@@ -2788,18 +2994,37 @@ class ServingEngine:
                     )
                     self._m_prefill_ms.observe(prefill_ms)
                 continue
-            e, _ = self._stream_row(s, st, [int(toks_host[s])], now)
+            if counts_host is None:
+                e, _ = self._stream_row(s, st, [int(toks_host[s])], now)
+            else:
+                # the on-device stop mask already froze the row at its
+                # EOS (or at lim); n is exactly the tokens it emitted.
+                # _stream_row's own trim still applies — a pipelined
+                # window planned against a stale `remaining` can carry
+                # more device tokens than the row has budget left, the
+                # same optimism the late-EOS path drops — and the
+                # trimmed tail counts as overrun
+                n = int(counts_host[s])
+                times = [window_t0 + j * step_s for j in range(n)]
+                e, _ = self._stream_row(
+                    s, st, toks_host[s, :n].tolist(), now, times=times)
+                overrun += n - e
             emitted += e
         if overrun:
             self.overrun_tokens += overrun
             self._m_overrun.inc(overrun)
         queue_depth = self.scheduler.depth()
-        device_ms = rec.dispatch_ms + wait_ms
         self._m_ticks.inc()
         self._m_tokens.inc(emitted)
         self._m_occupancy.set(sum(st is not None for st in self._slots))
-        self._m_tick_ms.observe(device_ms)
+        # serving_token_ms stays a PER-TOKEN series: a k-step window's
+        # device span covers k sampled tokens per live row
+        self._m_tick_ms.observe(device_ms / k)
         self._m_device_wait.observe(wait_ms)
+        self.dispatches += 1
+        self._m_dispatches.inc()
+        self._m_tokens_per_dispatch.observe(emitted)
+        self._m_multi_k.set(k)
         if rec.chunk is not None and rec.fed_tokens + rec.n_dec > 0:
             self._m_prefill_frac.observe(
                 rec.fed_tokens / (rec.fed_tokens + rec.n_dec))
@@ -2810,7 +3035,7 @@ class ServingEngine:
         self.metrics.log(
             step=self.ticks, occupancy=occupancy,
             queue_depth=queue_depth,
-            token_ms=round(device_ms, 3), **log_kw,
+            token_ms=round(device_ms / k, 3), **log_kw,
         )
         self._record_tick(
             plan_ms=rec.plan_ms, device_ms=device_ms,
@@ -2820,11 +3045,11 @@ class ServingEngine:
             emitted=emitted, occupancy=occupancy,
             queue_depth=queue_depth,
             device_wait_ms=wait_ms, dispatch_ms=rec.dispatch_ms,
-            overrun=overrun,
+            overrun=overrun, multi_k=rec.multi_k,
         )
 
     def _stream_row(self, s: int, st: _SlotState, toks_row, now,
-                    defer: Optional[list] = None):
+                    defer: Optional[list] = None, times=None):
         """Emit one row's tick tokens to its consumer stream, stopping
         at EOS or budget exhaustion (which completes the slot). Shared
         by every tick path. ``defer`` switches to the pipelined-spec
@@ -2832,7 +3057,9 @@ class ServingEngine:
         slot freeing) happens NOW — the next plan needs it — while the
         consumer-visible emission (stream puts, TTFT/ITL marks, the
         finish sentinel) is queued for :meth:`_flush_emissions` after
-        the next dispatch."""
+        the next dispatch. ``times`` (multi-step windows) carries one
+        timestamp per token so latency histograms see the window's
+        per-token cadence instead of one lump at reconcile."""
         req = st.req
         take: List[int] = []
         done = False
@@ -2849,25 +3076,32 @@ class ServingEngine:
                 done, reason = True, "length"
                 break
         if defer is None:
-            self._emit_now(req, take, now)
+            self._emit_now(req, take, now, times)
         else:
             defer.append(("toks", req, take))
         if done:
             self._complete(s, reason, defer=defer)
         return len(take), done
 
-    def _emit_now(self, req: Request, toks, now):
-        for tok in toks:
+    def _emit_now(self, req: Request, toks, now, times=None):
+        for i, tok in enumerate(toks):
+            t = now if times is None else times[i]
+            if req.last_token_t is not None and t < req.last_token_t:
+                # interpolated window timestamps never run time
+                # backwards across a reconcile boundary (a pipelined
+                # window can be dispatched before the previous one's
+                # tokens were stamped)
+                t = req.last_token_t
             if req.first_token_t is None:
-                req.first_token_t = now
-                ttft_ms = (now - req.submit_t) * 1e3
+                req.first_token_t = t
+                ttft_ms = (t - req.submit_t) * 1e3
                 self._m_ttft_ms.observe(ttft_ms)
                 self._m_qos_ttft.labels(tier=req.tier).observe(ttft_ms)
             else:
-                itl_ms = (now - req.last_token_t) * 1e3
+                itl_ms = (t - req.last_token_t) * 1e3
                 self._m_itl_ms.observe(itl_ms)
                 self._m_qos_itl.labels(tier=req.tier).observe(itl_ms)
-            req.last_token_t = now
+            req.last_token_t = t
             req.stream._put(tok)
 
     def _flush_emissions(self, defer: list):
@@ -3159,6 +3393,9 @@ class ServingEngine:
         self._m_occupancy.set(sum(st is not None for st in self._slots))
         self._m_tick_ms.observe(device_ms)
         self._m_device_wait.observe(wait_ms)
+        self.dispatches += 1
+        self._m_dispatches.inc()
+        self._m_tokens_per_dispatch.observe(emitted)
         if rec.fed_tokens + rec.n_dec > 0:
             self._m_prefill_frac.observe(
                 rec.fed_tokens / (rec.fed_tokens + rec.n_dec))
@@ -3228,6 +3465,106 @@ class ServingEngine:
             toks=toks, rows=rows, plan_ms=plan_ms,
             dispatch_ms=(time.perf_counter() - t0) * 1e3,
             n_dec=n_dec, fed_tokens=0, chunk=None,
+        )
+
+    # -- device-resident multi-step decode -----------------------------------
+
+    def _multi_gate(self) -> int:
+        """Decide this step's window width: the granted k (> 1) when
+        the engine is in all-decode steady state, else 1 with the
+        blocking condition counted as a fallback reason. Steady state
+        means every occupied slot is DECODING and nothing host-side
+        needs a tick boundary within the window: no speculative
+        verify (its plan needs each window's accepted tokens), no
+        staged control call (weight push / KV export must land between
+        dispatches), no host-tier restore queued, in flight, or
+        holding a row, and no prompt chunk to deal. A future
+        constrained/filtered row gates here too — any row whose
+        sampling needs per-token host work is not steady state. The
+        scheduler has the final word: a window charges every decoding
+        row one budget token per step, and a grant the budget cannot
+        cover falls back rather than starving prefill admissions."""
+        if self.multi_step_k <= 1:
+            return 1
+        if self.spec:
+            reason = "spec"
+        elif self._ctrl:
+            reason = "control"
+        elif (self._restore_queue or self._inflight_restores
+              or any(st is not None and st.restoring is not None
+                     for st in self._slots)):
+            reason = "restore"
+        elif any(st is not None and not st.decoding
+                 for st in self._slots):
+            reason = "prefill"
+        else:
+            n_dec = sum(1 for st in self._slots if st is not None)
+            granted = self.scheduler.plan_multi_step(
+                n_dec, self.multi_step_k)
+            if granted > 1:
+                return granted
+            reason = "budget"
+        self.multi_step_fallbacks[reason] = (
+            self.multi_step_fallbacks.get(reason, 0) + 1)
+        self._m_multi_fallbacks.labels(reason=reason).inc()
+        return 1
+
+    def _plan_dispatch_multi(self, k: int) -> _InflightTick:
+        """Plan and dispatch ONE k-step decode window (all-decode
+        steady state: every occupied slot is decoding, the gate said
+        so). The packed buffer carries each row's EOS id and its
+        emission limit ``min(k, remaining)`` — in steady state both are
+        constant, so the upload dedup re-dispatches the previous device
+        buffer and the slot path stays zero-upload. Paged cursors
+        advance by the worst case ``lim`` NOW (the next pipelined plan
+        must see the window's writes); a row that stops early always
+        COMPLETES at this window's reconcile — EOS or emptied budget
+        are the only stop reasons — where :meth:`_complete` returns its
+        whole block chain to the pool and zeroes its cursor in the same
+        reconcile, the PR-7 worst-case-rollback discipline."""
+        t_plan0 = time.perf_counter()
+        S = self.slots
+        cfgs = tuple(
+            (st.req.temperature, st.req.top_k, st.req.top_p)
+            if st else _IDLE_CFG
+            for st in self._slots
+        )
+        rows: List[Optional[tuple]] = [
+            ("dec", st) if st is not None else None
+            for st in self._slots
+        ]
+        n_dec = sum(1 for r in rows if r is not None)
+        eos = np.full((S,), -1, np.int32)
+        lim = np.zeros((S,), np.int32)
+        for s, st in enumerate(self._slots):
+            if st is None:
+                continue
+            if st.req.eos_id is not None:
+                eos[s] = st.req.eos_id
+            lim[s] = min(k, st.remaining)
+        if self.paged:
+            packed = _pack_i32(self._block_tables, self._seq_lens,
+                               eos, lim)
+            # REBIND, never mutate (aliasing hazard, see _decode_tick)
+            self._seq_lens = self._seq_lens + lim
+            tick = _paged_multi_tick_fn(self._dm_paged, cfgs, k,
+                                        self._ctx)
+        else:
+            packed = _pack_i32(eos, lim)
+            tick = _multi_tick_fn(self._dm_slot, cfgs, k, self._ctx)
+        t0 = time.perf_counter()
+        plan_ms = (t0 - t_plan0) * 1e3
+        dev = self._upload(packed)
+        (self._cache, self._last_logits, toks, counts,
+         self._rngs) = tick(
+            self._params_only, self._cache, self._last_logits,
+            self._rngs, dev,
+        )
+        return _InflightTick(
+            toks=toks, rows=rows, plan_ms=plan_ms,
+            dispatch_ms=(time.perf_counter() - t0) * 1e3,
+            n_dec=n_dec, fed_tokens=0, chunk=None,
+            multi_k=k, acc=counts,
         )
 
     def _complete(self, slot: int, reason: str,
@@ -3376,7 +3713,8 @@ class ServingEngine:
                      accepted_tokens: Optional[int] = None,
                      device_wait_ms: Optional[float] = None,
                      dispatch_ms: Optional[float] = None,
-                     overrun: int = 0):
+                     overrun: int = 0,
+                     multi_k: Optional[int] = None):
         """Post-tick runtime introspection + the flight snapshot. The
         whole call is self-timed against tick wall time —
         ``stats()["flight"]["overhead_frac"]`` is that ratio, and
@@ -3436,6 +3774,10 @@ class ServingEngine:
                 # report renderer's w=vN column)
                 "weight_version": self.weight_version,
             }
+            if multi_k is not None:
+                # multi-step window: this one dispatch carried up to
+                # multi_k decode steps per row (report's k= column)
+                snap["multi_k"] = multi_k
             if device_wait_ms is not None:
                 # overlap decomposition: device_ms = dispatch_ms (host
                 # side of the jitted call) + device_wait_ms (time
@@ -3520,6 +3862,18 @@ class ServingEngine:
                 "p99": self._m_itl_ms.percentile(99),
             },
             "decode_stalls": self._m_decode_stalls.value,
+            # device-resident multi-step decode: the configured window
+            # width, the per-reason count of planned ticks that fell
+            # back to k=1, and the tokens-per-dispatch amortization
+            # actually achieved (p50 pinned at the configured k in a
+            # true steady state)
+            "multi_step_k": self.multi_step_k,
+            "multi_step_fallbacks": dict(self.multi_step_fallbacks),
+            "dispatches": self.dispatches,
+            "tokens_per_dispatch": {
+                "p50": self._m_tokens_per_dispatch.percentile(50),
+                "p99": self._m_tokens_per_dispatch.percentile(99),
+            },
             "queue_oldest_wait_s": round(
                 self.scheduler.oldest_age_s(), 3),
             # runtime introspection: process-global jit traces of the

@@ -545,6 +545,22 @@ class FIFOScheduler:
             remain -= grant
         return takes, widths
 
+    def plan_multi_step(self, n_decoding: int, k: int) -> int:
+        """Window width for one device-resident multi-step decode
+        dispatch: a k-step window runs every decoding slot k steps, so
+        it charges ``n_decoding * k`` tokens against the SAME
+        ``tick_token_budget`` prompt chunks and verify windows spend —
+        one dispatch's worth of work stays one budget's worth of
+        tokens, whatever shape it takes. Returns the widest width the
+        budget covers, ``min(k, tick_token_budget // n_decoding)``,
+        floored at 1 (decode never stalls; 1 means the engine falls
+        back to the ordinary tick). There is no prefill claim to
+        interleave — the engine only asks for a window in all-decode
+        steady state, where no chunk is dealt by definition."""
+        if n_decoding < 1:
+            return 1
+        return max(1, min(int(k), self.tick_token_budget // n_decoding))
+
     def plan_restore(self, pending: int) -> int:
         """How many queued host-tier block restores one tick may issue:
         ``min(pending, restore_budget)``. Restores are host→device

@@ -271,6 +271,9 @@ def report_flight(path: str, last: Optional[int] = None,
         cells = (" ".join(_slot_cell(s) for s in slots)
                  if slots is not None else "")
         extra = ""
+        if "multi_k" in r:
+            # multi-step decode: this one dispatch ran a k-step window
+            extra += f"  k={r['multi_k']}"
         if "device_wait_ms" in r:
             # pipelined engines: how long the host actually blocked on
             # readback (device_ms minus what overlap hid)
@@ -338,6 +341,17 @@ def report_flight(path: str, last: Optional[int] = None,
             + (f"  pipeline_depth max {max(depth)}  "
                f"overrun_tokens {overrun}" if depth else "")
             + "\n"
+        )
+    if any("multi_k" in r for r in ticks):
+        # multi-step decode: how much of the retained window actually
+        # ran k-step dispatches, and the emitted-tokens amortization
+        multi = [r for r in ticks if "multi_k" in r]
+        toks = sum(int(r.get("emitted", 0)) for r in multi)
+        out.write(
+            f"multi-step: {len(multi)}/{len(ticks)} dispatches ran "
+            f"k>1 windows (k max {max(int(r['multi_k']) for r in multi)}"
+            f", {toks} tokens, "
+            f"{toks / max(len(multi), 1):.1f} tokens/dispatch)\n"
         )
     if any("demoted" in r for r in ticks):
         # tiered KV cache: total swap traffic across the retained

@@ -122,6 +122,12 @@ def main():
                          "before reading tick N's tokens (host "
                          "planning + streaming overlap device "
                          "compute; streams stay bit-identical)")
+    ap.add_argument("--multi-step-k", type=int, default=1,
+                    help="device-resident multi-step decode: run k "
+                         "decode steps per dispatch in all-decode "
+                         "steady state (streams stay bit-identical "
+                         "to k=1; watch tokens_per_dispatch in "
+                         "stats())")
     ap.add_argument("--replicas", type=int, default=1,
                     help="serve through the multi-replica fabric: this "
                          "many in-process LMServer replicas behind the "
@@ -159,6 +165,10 @@ def main():
         ]
 
     engine_kw = {}
+    if args.multi_step_k > 1:
+        engine_kw["multi_step_k"] = args.multi_step_k
+        print(f"multi-step decode: up to {args.multi_step_k} tokens "
+              f"per dispatch in all-decode steady state")
     if args.pipeline:
         engine_kw["pipeline"] = True
         print("pipelined engine loop: depth-2 (plan/stream tick N "
@@ -301,6 +311,15 @@ def main():
                 f"pipeline: {stats.get('overrun_tokens', 0)} overrun "
                 f"tokens dropped at reconciliation, device-wait p50 "
                 + (f"{dw:.2f}ms" if dw is not None else "n/a")
+            )
+        if args.multi_step_k > 1:
+            tpd = stats.get("tokens_per_dispatch", {}).get("p50")
+            print(
+                f"multi-step: k={stats.get('multi_step_k')}, "
+                f"{stats.get('dispatches', 0)} dispatches, "
+                f"tokens/dispatch p50 "
+                + (f"{tpd:.2f}" if tpd is not None else "n/a")
+                + f", fallbacks {stats.get('multi_step_fallbacks', {})}"
             )
         if args.draft is not None:
             rate = (stats["accepted_tokens"] / stats["draft_tokens"]

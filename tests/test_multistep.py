@@ -1,0 +1,482 @@
+"""Device-resident multi-step decode (ServingEngine(multi_step_k=k)):
+the k-step steady-state window must be OBSERVABLY identical to the k=1
+reference loop — bit-identical token streams (greedy AND sampled RNG
+chains) across slot/paged × sync/pipelined × chunked/monolithic ×
+tp=1/4 × spec-ngram, late-EOS overruns trimmed at any step of the
+window with the preallocated paged tail returned in the same reconcile
+(flat steady-state block occupancy), k=1 fallback on every
+non-steady-state condition (chunk dealt / restore / weight push /
+budget), per-token ITL timestamps instead of one k-wide lump, and zero
+steady-state recompiles for fixed k. Plus the scheduler's
+plan_multi_step budget satellite, the stats()/flight/report surfaces,
+and the serve_bench --multi-step --smoke drift guard."""
+
+import io
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from distkeras_tpu import telemetry
+from distkeras_tpu.models import get_model
+from distkeras_tpu.models.transformer import generate
+from distkeras_tpu.serving import FIFOScheduler, ServingEngine
+from distkeras_tpu.telemetry import report
+
+KW = dict(vocab_size=64, d_model=32, num_heads=4, num_layers=2,
+          max_len=64, dtype=jnp.float32, attention="dense",
+          pos_emb="rope", num_kv_heads=2)
+
+
+def _model_and_params(seed=0, **over):
+    kw = dict(KW)
+    kw.update(over)
+    model = get_model("transformer_lm", **kw)
+    params = model.init(jax.random.PRNGKey(seed),
+                        jnp.zeros((1, 4), jnp.int32))
+    return model, params
+
+
+def _workload(n=6, vocab=64, prompt_len=10):
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, vocab, size=prompt_len).astype(np.int32)
+               for _ in range(n)]
+    lens = [7, 12, 5, 20, 9, 16][:n]
+    temps = [0.0, 0.8, 0.0, 1.0, 0.0, 0.7][:n]
+    return prompts, lens, temps
+
+
+def _engine(model, params, paged, **kw):
+    kw.setdefault("registry", telemetry.MetricRegistry())
+    kw.setdefault("tracer", telemetry.Tracer())
+    if paged:
+        kw.setdefault("block_size", 8)
+    return ServingEngine(model, params, paged=paged, **kw)
+
+
+def _serve(model, params, paged, prompts, lens, temps, **kw):
+    eng = _engine(model, params, paged, slots=3, **kw)
+    reqs = [eng.submit(p, max_new_tokens=m, temperature=t, seed=i)
+            for i, (p, m, t) in enumerate(zip(prompts, lens, temps))]
+    eng.drain()
+    return [r.stream.tokens(timeout=60) for r in reqs], eng
+
+
+def _solo(model, params, prompts, lens, temps):
+    return [
+        np.asarray(generate(
+            model, params, jnp.asarray(p)[None], m, temperature=t,
+            seed=i))[0, len(p):].tolist()
+        for i, (p, m, t) in enumerate(zip(prompts, lens, temps))
+    ]
+
+
+def _ran_windows(eng):
+    """True iff at least one k>1 window actually dispatched (guards the
+    parity assertions against a vacuously-disabled fast path)."""
+    return any(r.get("multi_k", 1) > 1 for r in eng.flight.snapshots())
+
+
+# -- k>1 vs k=1 bit-parity matrix --------------------------------------------
+
+
+@pytest.mark.parametrize("mode", ["slot", "paged"])
+@pytest.mark.parametrize("pipeline", [False, True])
+def test_multistep_parity_matrix(mode, pipeline):
+    """k=4 streams (greedy AND sampled RNG chains, mixed per-slot
+    configs, late length-finishes) must be token-identical to the k=1
+    loop AND to solo generate(), with the fast path demonstrably
+    engaged."""
+    model, params = _model_and_params()
+    prompts, lens, temps = _workload()
+    kw = dict(prefill_chunk=4, pipeline=pipeline)
+    ref, _ = _serve(model, params, mode == "paged", prompts, lens,
+                    temps, **kw)
+    multi, eng = _serve(model, params, mode == "paged", prompts, lens,
+                        temps, multi_step_k=4, **kw)
+    assert ref == _solo(model, params, prompts, lens, temps)
+    assert multi == ref
+    assert _ran_windows(eng)
+    st = eng.stats()
+    assert st["multi_step_k"] == 4
+    # admission phases fall back (a non-decoding row is not steady
+    # state); the counter attributes them
+    assert st["multi_step_fallbacks"].get("prefill", 0) > 0
+
+
+@pytest.mark.parametrize("mode", ["slot", "paged"])
+def test_multistep_k2_parity(mode):
+    model, params = _model_and_params()
+    prompts, lens, temps = _workload()
+    ref, _ = _serve(model, params, mode == "paged", prompts, lens,
+                    temps, prefill_chunk=4)
+    multi, eng = _serve(model, params, mode == "paged", prompts, lens,
+                        temps, prefill_chunk=4, multi_step_k=2)
+    assert multi == ref
+    assert _ran_windows(eng)
+
+
+@pytest.mark.parametrize("mode", ["slot", "paged"])
+def test_multistep_monolithic_parity(mode):
+    """Legacy monolithic prefill (prefill_chunk=None) composes with the
+    window: decode steady state looks the same either way."""
+    model, params = _model_and_params()
+    prompts, lens, temps = _workload()
+    ref, _ = _serve(model, params, mode == "paged", prompts, lens,
+                    temps, prefill_chunk=None)
+    multi, eng = _serve(model, params, mode == "paged", prompts, lens,
+                        temps, prefill_chunk=None, multi_step_k=4)
+    assert multi == ref == _solo(model, params, prompts, lens, temps)
+    assert _ran_windows(eng)
+
+
+@pytest.mark.slow  # sampled rows also run in the parity matrix; the
+# all-sampled sweep rides the multichip CI job (no marker filter)
+def test_rng_chain_parity_all_sampled():
+    """Every row sampled (temperature>0, distinct seeds): the per-token
+    jax.random.split chain inside the scan must replay the k=1 chain
+    exactly — any skipped or extra split diverges immediately."""
+    model, params = _model_and_params()
+    prompts, lens, _ = _workload()
+    temps = [0.7, 0.8, 1.0, 0.9, 0.6, 1.1]
+    ref, _ = _serve(model, params, False, prompts, lens, temps,
+                    prefill_chunk=4)
+    for mode in ("slot", "paged"):
+        multi, eng = _serve(model, params, mode == "paged", prompts,
+                            lens, temps, prefill_chunk=4,
+                            multi_step_k=4)
+        assert multi == ref, mode
+        assert _ran_windows(eng)
+
+
+def test_multistep_spec_ngram_fallback_parity():
+    """Speculative engines never window (each verify plan needs the
+    previous window's accepted tokens): the knob must fall back with
+    reason "spec" and leave streams untouched."""
+    model, params = _model_and_params()
+    prompts, lens, temps = _workload()
+    kw = dict(prefill_chunk=4, draft="ngram", spec_k=3)
+    ref, _ = _serve(model, params, False, prompts, lens, temps, **kw)
+    multi, eng = _serve(model, params, False, prompts, lens, temps,
+                        multi_step_k=4, **kw)
+    assert multi == ref
+    st = eng.stats()
+    assert st["multi_step_fallbacks"].get("spec", 0) > 0
+    assert not _ran_windows(eng)
+
+
+# -- late-EOS trim matrix ----------------------------------------------------
+
+
+@pytest.mark.parametrize("mode", ["slot", "paged"])
+@pytest.mark.parametrize("step", [0, 1, 2, 3])
+def test_late_eos_trim_matrix(mode, step):
+    """EOS landing at step 1..k of a window: the on-device stop mask
+    freezes the row, reconcile trims nothing past the EOS token, and
+    (paged) the whole block chain — including the tail preallocated for
+    the unemitted steps — returns to the pool in the same reconcile."""
+    model, params = _model_and_params()
+    prompts, lens, temps = _workload()
+    ref = _solo(model, params, prompts, lens, temps)
+    # an EOS id that request 0 emits at window step `step`; other rows
+    # may or may not hit it — both paths exercised either way
+    eos = ref[0][step]
+
+    def serve_eos(k):
+        eng = _engine(model, params, mode == "paged", slots=3,
+                      prefill_chunk=4, multi_step_k=k)
+        reqs = [eng.submit(p, max_new_tokens=m, temperature=t, seed=i,
+                           eos_id=eos)
+                for i, (p, m, t) in enumerate(zip(prompts, lens, temps))]
+        eng.drain()
+        return [r.stream.tokens(timeout=60) for r in reqs], eng
+
+    r1, _ = serve_eos(1)
+    rk, eng = serve_eos(4)
+    assert rk == r1
+    if mode == "paged":
+        ps = eng.pool.stats()
+        assert ps["live"] == 0, ps
+
+
+def test_paged_block_occupancy_flat_across_eos_churn():
+    """Regression (leak satellite): early-EOS windows must not strand
+    the preallocated tail blocks — steady-state occupancy is flat, so
+    blocks_reclaimable (the Autoscaler's pressure signal) never decays
+    across churn rounds."""
+    model, params = _model_and_params()
+    prompts, lens, temps = _workload()
+    ref = _solo(model, params, prompts, lens, temps)
+    eng = _engine(model, params, True, slots=3, prefill_chunk=4,
+                  multi_step_k=4, num_blocks=16, prefix_cache=False)
+    reclaimable = []
+    for round_i in range(3):
+        # EOS chosen mid-stream so every round stops early mid-window
+        eos = ref[0][1 + round_i]
+        reqs = [eng.submit(p, max_new_tokens=m, temperature=t, seed=i,
+                           eos_id=eos)
+                for i, (p, m, t) in enumerate(zip(prompts, lens, temps))]
+        eng.drain()
+        for r in reqs:
+            r.stream.tokens(timeout=60)
+        ps = eng.pool.stats()
+        assert ps["live"] == 0, (round_i, ps)
+        reclaimable.append(eng.stats()["blocks_reclaimable"])
+    assert _ran_windows(eng)
+    assert len(set(reclaimable)) == 1, reclaimable
+
+
+# -- fallback triggers -------------------------------------------------------
+
+
+def _decode_steady_engine(model, params, **kw):
+    """One request admitted and fully decoded into steady state, engine
+    still occupied (long budget remaining)."""
+    eng = _engine(model, params, False, slots=2, prefill_chunk=4,
+                  multi_step_k=4, **kw)
+    prompts, _, _ = _workload(1)
+    req = eng.submit(prompts[0], max_new_tokens=40, temperature=0.0,
+                     seed=0)
+    for _ in range(50):
+        if any(st is not None and st.decoding for st in eng._slots):
+            break
+        eng.step()
+    assert any(st is not None and st.decoding for st in eng._slots)
+    return eng, req
+
+
+def test_multi_gate_fallback_reasons():
+    """Unit-probe the gate: each non-steady-state condition forces k=1
+    with its reason attributed, and clearing it restores the window."""
+    model, params = _model_and_params()
+    eng, req = _decode_steady_engine(model, params)
+    base = dict(eng.multi_step_fallbacks)  # admission counted "prefill"
+    assert eng._multi_gate() > 1
+    assert dict(eng.multi_step_fallbacks) == base  # grants don't count
+
+    # staged control call (weight push / KV export marshalled between
+    # dispatches) must land before any k-wide window starts
+    eng._ctrl.append((lambda: None, None, {}))
+    assert eng._multi_gate() == 1
+    eng._ctrl.clear()
+
+    # host-tier restore queued or in flight
+    eng._restore_queue.append(("h", 0))
+    assert eng._multi_gate() == 1
+    eng._restore_queue.clear()
+    eng._inflight_restores["h"] = 0
+    assert eng._multi_gate() == 1
+    eng._inflight_restores.clear()
+
+    # a chunk-dealing (non-decoding) row
+    s = next(i for i, st in enumerate(eng._slots) if st is not None)
+    eng._slots[s].decoding = False
+    assert eng._multi_gate() == 1
+    eng._slots[s].decoding = True
+
+    # budget too tight for a window: 1 decoding row * k=4 > budget 1
+    saved = eng.scheduler.tick_token_budget
+    eng.scheduler.tick_token_budget = 1
+    assert eng._multi_gate() == 1
+    eng.scheduler.tick_token_budget = saved
+
+    seen = eng.stats()["multi_step_fallbacks"]
+    delta = {r: seen.get(r, 0) - base.get(r, 0)
+             for r in ("control", "restore", "prefill", "budget")}
+    assert delta == {
+        "control": 1, "restore": 2, "prefill": 1, "budget": 1}
+    assert eng._multi_gate() > 1  # steady state again
+    eng.drain()
+    assert req.stream.tokens(timeout=60)
+
+
+def test_fallback_chunk_dealt_mid_drain():
+    """A request arriving mid-decode forces k=1 while its chunks deal,
+    then the window resumes — streams on both sides stay exact."""
+    model, params = _model_and_params()
+    prompts, lens, temps = _workload(4)
+    eng = _engine(model, params, False, slots=2, prefill_chunk=4,
+                  multi_step_k=4)
+    first = [eng.submit(p, max_new_tokens=m, temperature=t, seed=i)
+             for i, (p, m, t) in enumerate(
+                 zip(prompts[:2], lens[:2], temps[:2]))]
+    for _ in range(6):  # into decode steady state: windows running
+        eng.step()
+    late = [eng.submit(p, max_new_tokens=m, temperature=t, seed=i + 2)
+            for i, (p, m, t) in enumerate(
+                zip(prompts[2:], lens[2:], temps[2:]))]
+    eng.drain()
+    streams = [r.stream.tokens(timeout=60) for r in first + late]
+    assert streams == _solo(model, params, prompts, lens, temps)
+    st = eng.stats()
+    assert st["multi_step_fallbacks"].get("prefill", 0) > 0
+    assert _ran_windows(eng)
+
+
+def test_weight_push_mid_drain_parity():
+    """A live weight swap between windows (same weights, bumped
+    version): the swap lands at a dispatch boundary and the streams
+    stay bit-identical to the no-push reference."""
+    model, params = _model_and_params()
+    prompts, lens, temps = _workload()
+    eng = _engine(model, params, False, slots=3, prefill_chunk=4,
+                  multi_step_k=4)
+    reqs = [eng.submit(p, max_new_tokens=m, temperature=t, seed=i)
+            for i, (p, m, t) in enumerate(zip(prompts, lens, temps))]
+    for _ in range(5):
+        eng.step()
+    out = eng.update_weights({"params": params["params"]}, version=7)
+    assert out["version"] == 7
+    eng.drain()
+    streams = [r.stream.tokens(timeout=60) for r in reqs]
+    assert streams == _solo(model, params, prompts, lens, temps)
+    assert eng.weight_version == 7
+    assert _ran_windows(eng)
+
+
+# -- ITL attribution ---------------------------------------------------------
+
+
+def test_itl_per_token_timestamps():
+    """One k-wide readback must stamp its k tokens with k distinct,
+    strictly increasing timestamps (device window spread over the
+    emitted tokens) — not one lump that shows up as a k-wide ITL spike
+    in the QoS histograms."""
+    model, params = _model_and_params()
+    prompts, lens, temps = _workload()
+    eng = _engine(model, params, False, slots=3, prefill_chunk=4,
+                  multi_step_k=4)
+    captured = []
+    orig = eng._emit_now
+
+    def spy(req, toks, now, times=None):
+        captured.append((len(toks), times))
+        return orig(req, toks, now, times)
+
+    eng._emit_now = spy
+    reqs = [eng.submit(p, max_new_tokens=m, temperature=t, seed=i)
+            for i, (p, m, t) in enumerate(zip(prompts, lens, temps))]
+    eng.drain()
+    for r in reqs:
+        r.stream.tokens(timeout=60)
+    wide = [(n, times) for n, times in captured
+            if times is not None and n > 1]
+    assert wide, "no multi-token emission captured"
+    for n, times in wide:
+        assert len(times) >= n
+        used = times[:n]
+        assert all(b > a for a, b in zip(used, used[1:])), used
+
+
+# -- zero steady-state recompiles --------------------------------------------
+
+
+@pytest.mark.parametrize("mode", ["slot", "paged"])
+def test_zero_steady_state_recompiles(mode):
+    """Warm the tick family, mark steady, replay the workload: a fixed
+    k must never retrace (window shapes, packed-control shapes, and
+    donation all constant in steady state)."""
+    model, params = _model_and_params()
+    prompts, lens, temps = _workload()
+    eng = _engine(model, params, mode == "paged", slots=3,
+                  prefill_chunk=4, multi_step_k=4)
+    for _ in range(2):
+        reqs = [eng.submit(p, max_new_tokens=m, temperature=t, seed=i)
+                for i, (p, m, t) in enumerate(zip(prompts, lens, temps))]
+        eng.drain()
+        for r in reqs:
+            r.stream.tokens(timeout=60)
+    eng.mark_steady()
+    reqs = [eng.submit(p, max_new_tokens=m, temperature=t, seed=i)
+            for i, (p, m, t) in enumerate(zip(prompts, lens, temps))]
+    eng.drain()
+    for r in reqs:
+        r.stream.tokens(timeout=60)
+    assert eng.recompiles_since_mark() == {}
+    assert _ran_windows(eng)
+
+
+# -- scheduler budget satellite ----------------------------------------------
+
+
+def test_scheduler_plan_multi_step():
+    """A k-step window charges n_decoding*k against the same
+    tick_token_budget: widest covered width, floored at 1."""
+    s = FIFOScheduler(tick_token_budget=8)
+    assert s.plan_multi_step(1, 8) == 8
+    assert s.plan_multi_step(2, 8) == 4
+    assert s.plan_multi_step(3, 8) == 2
+    assert s.plan_multi_step(8, 4) == 1   # 8//8 == 1: fall back
+    assert s.plan_multi_step(0, 8) == 1   # no decoding rows
+    assert s.plan_multi_step(2, 3) == 3   # k caps the grant
+
+
+# -- stats / flight / report surfaces ----------------------------------------
+
+
+def test_stats_flight_and_report_surfaces(tmp_path):
+    model, params = _model_and_params()
+    prompts, lens, temps = _workload()
+    _, eng = _serve(model, params, False, prompts, lens, temps,
+                    prefill_chunk=4, multi_step_k=4)
+    st = eng.stats()
+    assert st["multi_step_k"] == 4
+    assert st["dispatches"] > 0
+    assert isinstance(st["multi_step_fallbacks"], dict)
+    assert st["tokens_per_dispatch"]["p50"] is not None
+    # fewer dispatches than tokens: the window amortized the readbacks
+    total = sum(lens)
+    assert st["dispatches"] < total
+    snaps = eng.flight.snapshots()
+    ks = [r["multi_k"] for r in snaps if "multi_k" in r]
+    assert ks and max(ks) > 1
+    path = os.path.join(str(tmp_path), "flight.jsonl")
+    eng.flight.dump(path, reason="test")
+    out = io.StringIO()
+    report.report_flight(path, out=out)
+    text = out.getvalue()
+    assert "k=" in text
+    assert "multi-step:" in text
+
+
+# -- tensor parallel ---------------------------------------------------------
+
+
+@pytest.mark.parametrize("mode", [
+    "slot", pytest.param("paged", marks=pytest.mark.slow)])
+def test_multistep_tp4_parity(mode):
+    """k=4 windows under tp=4 shard_map: streams identical to the tp=4
+    k=1 reference (runs in the forced 4-device mesh CI job)."""
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 devices (XLA_FLAGS host platform count)")
+    from distkeras_tpu.parallel.mesh import make_mesh
+    model, params = _model_and_params(num_heads=8, num_kv_heads=4)
+    prompts, lens, temps = _workload(3)
+    mesh = make_mesh({"model": 4})
+    ref, _ = _serve(model, params, mode == "paged", prompts, lens,
+                    temps, prefill_chunk=4, mesh=mesh)
+    multi, eng = _serve(model, params, mode == "paged", prompts, lens,
+                        temps, prefill_chunk=4, mesh=mesh,
+                        multi_step_k=4)
+    assert multi == ref
+    assert _ran_windows(eng)
+
+
+# -- serve_bench drift guard -------------------------------------------------
+
+
+@pytest.mark.slow
+def test_serve_bench_multistep_smoke():
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "benchmarks"))
+    import serve_bench
+    r = serve_bench.bench_multistep(smoke=True)
+    assert r["parity"] is True
+    assert r["multi_steady_recompiles"] == {}
+    ks = sorted(int(k.split("k")[-1]) for k in r if k.startswith("tok_s_k"))
+    assert ks[0] == 1 and len(ks) >= 2
