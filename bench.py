@@ -34,6 +34,17 @@ check the claim by running that script on period hardware; BASELINE.md
 §"vs_baseline anchor" records the same derivation. So
 vs_baseline = samples_per_sec / 2000 and the >=5x goal reads as
 vs_baseline >= 5.
+
+``--check-regression NEW.json`` compares one run's JSON (raw bench
+output or a ``BENCH_r*.json`` wrapper) against the median of the
+trailing history files: throughput-shaped keys (``value``,
+``*tokens_per_sec*``, ``*tok_s*``) may not drop more than 15% below
+the median, MFU-shaped keys not more than 10%, and a historical
+numeric key that vanished (usually replaced by a ``*_error`` fold)
+is flagged too. Offending keys print one line each and the exit
+status is 1; ``--out`` writes the full comparison as JSON for CI
+artifact upload. The tier-1 workflow runs this non-gating — the
+numbers steer, the functional tests gate.
 """
 
 import functools
@@ -655,5 +666,134 @@ def serve_router_bench():
         return {"serve_router_error": f"{type(e).__name__}: {e}"}
 
 
+# -- BENCH-history regression gate (tier-1 non-gating step) ------------------
+
+# how far below the trailing-history median a key may fall before it
+# counts as a regression: throughput-shaped 15%, utilization 10%
+THROUGHPUT_TOLERANCE = 0.15
+MFU_TOLERANCE = 0.10
+
+
+def _tolerance_for(key):
+    """The drop tolerance for one BENCH key, or None when the key is
+    not regression-gated (configs, ratios, counters, histograms)."""
+    if "mfu" in key and not key.endswith("_method"):
+        return MFU_TOLERANCE
+    if (key == "value" or "tokens_per_sec" in key or "tok_s" in key
+            or "samples_per_sec" in key):
+        return THROUGHPUT_TOLERANCE
+    return None
+
+
+def _bench_numbers(doc):
+    """The numeric metric dict of one BENCH file — accepts both the raw
+    one-line bench output and the ``{"parsed": {...}}`` wrapper."""
+    parsed = doc.get("parsed", doc)
+    if not isinstance(parsed, dict):
+        return {}
+    return {k: float(v) for k, v in parsed.items()
+            if isinstance(v, (int, float)) and not isinstance(v, bool)}
+
+
+def check_regression(new, history):
+    """Compare one run's numbers against the per-key median of the
+    trailing history runs. Returns the comparison document; the CLI
+    turns a non-empty ``regressions``/``missing`` into exit 1."""
+    import statistics
+
+    cur = _bench_numbers(new)
+    hist = [_bench_numbers(h) for h in history]
+    hist = [h for h in hist if h]
+    comparison = {"baseline_runs": len(hist), "checked": [],
+                  "regressions": [], "missing": []}
+    gated = sorted(k for h in hist for k in h
+                   if _tolerance_for(k) is not None)
+    for key in dict.fromkeys(gated):  # ordered de-dup
+        vals = [h[key] for h in hist if key in h]
+        median = statistics.median(vals)
+        tol = _tolerance_for(key)
+        if key not in cur:
+            # the number disappeared — usually an *_error fold ate it
+            comparison["missing"].append(
+                {"key": key, "median": round(median, 4)})
+            continue
+        floor = median * (1.0 - tol)
+        entry = {"key": key, "value": round(cur[key], 4),
+                 "median": round(median, 4), "floor": round(floor, 4),
+                 "tolerance": tol, "runs": len(vals)}
+        comparison["checked"].append(entry)
+        if median > 0 and cur[key] < floor:
+            comparison["regressions"].append(entry)
+    return comparison
+
+
+def check_regression_cli(argv=None):
+    import argparse
+    import glob
+    import os
+    import sys
+
+    ap = argparse.ArgumentParser(
+        prog="bench.py",
+        description="Gate one BENCH run against the trailing "
+                    "BENCH_r*.json history (non-gating in CI: prints "
+                    "offending keys, exits 1 on regression).")
+    ap.add_argument("--check-regression", metavar="NEW_JSON",
+                    required=True, dest="new",
+                    help="the run to check: raw bench JSON output or "
+                         "a BENCH_r*.json wrapper")
+    ap.add_argument("--history", default=None,
+                    help="history glob (default: BENCH_r*.json next "
+                         "to bench.py, excluding NEW_JSON)")
+    ap.add_argument("--window", type=int, default=3,
+                    help="trailing history files to median over "
+                         "(default 3)")
+    ap.add_argument("--out", default=None,
+                    help="write the full comparison JSON here "
+                         "(the CI artifact)")
+    args = ap.parse_args(argv)
+
+    def load(path):
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"error: cannot read {path}: {e}", file=sys.stderr)
+            raise SystemExit(2)
+
+    pattern = args.history or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_r*.json")
+    paths = [p for p in sorted(glob.glob(pattern))
+             if os.path.abspath(p) != os.path.abspath(args.new)]
+    if not paths:
+        print(f"error: no history files match {pattern}",
+              file=sys.stderr)
+        raise SystemExit(2)
+    history = [load(p) for p in paths[-args.window:]]
+    comparison = check_regression(load(args.new), history)
+    comparison["history_files"] = [os.path.basename(p)
+                                   for p in paths[-args.window:]]
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(comparison, f, indent=2, sort_keys=True)
+    for r in comparison["regressions"]:
+        print(f"REGRESSION {r['key']}: {r['value']} < floor "
+              f"{r['floor']} (median {r['median']} over {r['runs']} "
+              f"runs, -{r['tolerance']:.0%} tolerance)")
+    for m in comparison["missing"]:
+        print(f"MISSING {m['key']}: present in history "
+              f"(median {m['median']}), absent from this run")
+    bad = len(comparison["regressions"]) + len(comparison["missing"])
+    print(f"checked {len(comparison['checked'])} keys against "
+          f"{comparison['baseline_runs']} runs: "
+          f"{len(comparison['regressions'])} regression(s), "
+          f"{len(comparison['missing'])} missing")
+    return 1 if bad else 0
+
+
 if __name__ == "__main__":
+    import sys
+
+    if any(a.startswith("--check-regression") for a in sys.argv[1:]):
+        sys.exit(check_regression_cli(sys.argv[1:]))
     main()

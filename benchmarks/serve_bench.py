@@ -2014,11 +2014,17 @@ def bench_fleet_sim(V=256, D=64, H=2, L=2, slots=2,
         # per-replica SLO monitor: the controller's burn signals flow
         # through manager.aggregate_alerts() -> these monitors. Bounds
         # are lenient — this sim drives scaling with queue depth; the
-        # burn-driven paths are covered by tests/test_controller.py
+        # burn-driven paths are covered by tests/test_controller.py.
+        # The anomaly twins ride along with CI-speed calibration
+        # (baseline+ramp train the EWMA, the 10x burst deviates): the
+        # smoke asserts at least one fires
         slo = telemetry.SloMonitor(
             telemetry.default_serving_rules(
                 itl_p99_ms=10_000.0, ttft_p99_ms=120_000.0,
-                max_queue_depth=1e9, max_expiry_per_s=1e9),
+                max_queue_depth=1e9, max_expiry_per_s=1e9)
+            + telemetry.default_anomaly_rules(
+                z_threshold=3.0, min_samples=8,
+                windows=(0.75, 2.0)),
             registry=reg, tracer=tracer, interval_s=0.25)
         servers[f"r{i}"] = LMServer(eng, slo=slo).start()
 
@@ -2205,6 +2211,76 @@ def bench_fleet_sim(V=256, D=64, H=2, L=2, slots=2,
         for t in preempt:
             preempt[t] += int(qos.get(t, {}).get("preempted_chunks", 0))
 
+    # ---- time-series / journal forensics (scraped over the live wire
+    # BEFORE teardown — this is the fleet-wide `timeseries`/`events`
+    # path the operator tooling uses)
+    import io
+
+    from distkeras_tpu.telemetry.report import render_fleet_timeline
+    from distkeras_tpu.telemetry.timeseries import write_timeline
+
+    fleet_ts = router.fleet_timeseries()
+    fleet_ev = router.fleet_events()
+    scale_events = [e for e in fleet_ev["events"]
+                    if e.get("actor") == "autoscaler"]
+    # the journal must reconcile 1:1 with the controller's own decision
+    # log — same actions, same polls, same reasons, in order
+    journal_reconciles = (
+        [(e["action"], e.get("poll"), e.get("reason"))
+         for e in scale_events]
+        == [(d["action"], d.get("poll"), d.get("reason"))
+            for d in auto.decisions()])
+    events_ordered = all(
+        a["t"] <= b["t"] for a, b in zip(fleet_ev["events"],
+                                         fleet_ev["events"][1:]))
+    tl_path = "/tmp/distkeras-fleet-timeline.jsonl"
+    write_timeline(tl_path, fleet_ts["points"], fleet_ev["events"],
+                   meta=fleet_ts["meta"])
+    buf = io.StringIO()
+    try:
+        render_fleet_timeline(fleet_ts["points"], fleet_ev["events"],
+                              meta=fleet_ts["meta"], out=buf)
+        rendered = buf.getvalue()
+        timeline_renders = (events_ordered and all(
+            e["action"] in rendered for e in scale_events))
+    except Exception:
+        timeline_renders = False
+    # anomaly firings: the cumulative slo_alerts_total counter per
+    # *_anomaly rule, summed across every replica's registry
+    anomaly_fired: dict = {}
+    for s in servers.values():
+        fam = s.engine.registry.collect().get("slo_alerts_total") or {}
+        for se in fam.get("series", []):
+            rule = se["labels"].get("rule", "")
+            if rule.endswith("_anomaly") and se["value"] > 0:
+                anomaly_fired[rule] = (anomaly_fired.get(rule, 0)
+                                       + int(se["value"]))
+    ts_overhead = max(
+        (s.timeseries.meta()["overhead_frac"]
+         for s in servers.values() if s.timeseries is not None),
+        default=0.0)
+    ts_overhead = max(ts_overhead,
+                      router.timeseries.meta()["overhead_frac"])
+    # the p99 ITL exemplar must name a trace the router actually
+    # archived — the registry→trace join is the whole point
+    archived = set(router.archive.ids()) if router.archive else set()
+    exemplar_ids = []
+    for s in servers.values():
+        try:
+            ex = s.engine.stats()["itl_ms"]["p99_exemplar"]
+        except Exception:
+            ex = None
+        if ex and ex.get("trace_id") is not None:
+            exemplar_ids.append(ex["trace_id"])
+
+    def _resolves(tid):
+        try:
+            return int(tid) in archived
+        except (TypeError, ValueError):
+            return False
+
+    exemplar_resolved = any(_resolves(t) for t in exemplar_ids)
+
     def pct(vals, q):
         return (round(float(np.percentile(np.asarray(vals), q)), 1)
                 if vals else None)
@@ -2244,6 +2320,17 @@ def bench_fleet_sim(V=256, D=64, H=2, L=2, slots=2,
         "actions": [{k: e.get(k) for k in
                      ("action", "reason", "replica", "ok")}
                     for e in acts],
+        "journal_events": len(fleet_ev["events"]),
+        "journal_scale_events": len(scale_events),
+        "journal_reconciles": journal_reconciles,
+        "anomaly_rules_fired": sorted(anomaly_fired),
+        "anomaly_firings": sum(anomaly_fired.values()),
+        "timeseries_points": len(fleet_ts["points"]),
+        "timeseries_sources": fleet_ts["meta"].get("sources"),
+        "timeseries_overhead_frac": round(ts_overhead, 6),
+        "timeline_path": tl_path,
+        "timeline_renders": timeline_renders,
+        "itl_p99_exemplar_resolved": exemplar_resolved,
         "steady_recompiles": recomp,
         "n_devices": len(jax.devices()),
         "backend": jax.default_backend(),
@@ -2271,6 +2358,18 @@ def bench_fleet_sim(V=256, D=64, H=2, L=2, slots=2,
                 > result["burst_ttft_p99_interactive_ms"]), result
         assert result["batch_preempted_chunks"] >= 1, result
         assert result["steady_recompiles"] == {}, result
+        # the observability contract: the journal IS the decision log,
+        # the burst registers as an anomaly, the timeline renders with
+        # every scale action in timestamp order, sampling stays under
+        # 1% overhead, and the tail exemplar joins to a real archived
+        # trace
+        assert result["journal_reconciles"], result
+        assert result["journal_scale_events"] == len(acts), result
+        assert result["anomaly_firings"] >= 1, result
+        assert result["timeline_renders"], result
+        assert result["timeseries_points"] >= 1, result
+        assert result["timeseries_overhead_frac"] < 0.01, result
+        assert result["itl_p99_exemplar_resolved"], result
     client.close()
     router.stop()
     for s in servers.values():

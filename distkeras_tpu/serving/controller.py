@@ -341,6 +341,17 @@ class Autoscaler:
             reason=action.get("reason"),
             replica=action.get("replica"),
         )
+        # every actuated decision (including failed ones — the ok
+        # flag distinguishes) lands in the router's control-plane
+        # journal, so `report --timeline` reconciles the journal's
+        # scale events 1:1 against decisions()
+        journal = getattr(self.router, "journal", None)
+        if journal is not None:
+            journal.append(action["action"],
+                           target=action.get("replica"),
+                           actor="autoscaler",
+                           reason=action.get("reason"),
+                           ok=action["ok"], poll=action["poll"])
         return action
 
     def start(self) -> "Autoscaler":

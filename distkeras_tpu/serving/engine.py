@@ -89,6 +89,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from distkeras_tpu import telemetry
 from distkeras_tpu.models.transformer import filter_logits, sample_tokens
+from distkeras_tpu.telemetry.events import EventJournal
 from distkeras_tpu.telemetry.flight import FlightRecorder
 from distkeras_tpu.telemetry.runtime import MemoryWatermarks, recompiles
 from distkeras_tpu.telemetry.slo import StallWatchdog
@@ -1401,6 +1402,9 @@ class ServingEngine:
         self.paged = paged
         self.registry = registry or telemetry.get_registry()
         self.tracer = tracer or telemetry.get_tracer()
+        # control-plane journal: drain/undrain, role flips, weight
+        # swaps — served by the `events` op and merged fleet-wide
+        self.journal = EventJournal(actor="engine")
         self.scheduler = scheduler or FIFOScheduler(
             tracer=self.tracer, registry=self.registry
         )
@@ -2075,6 +2079,9 @@ class ServingEngine:
         ``draining`` flips True here, ``drained`` once the queue and
         every slot are empty. Idempotent; served over TCP as the
         ``drain`` op (:meth:`ServingClient.drain`)."""
+        if not self.draining:
+            self.journal.append("drain",
+                                queued=self.scheduler.depth())
         self.draining = True
 
     def end_drain(self):
@@ -2082,6 +2089,8 @@ class ServingEngine:
         half of the rolling-update primitive (drain → push weights →
         undrain). Idempotent; served over TCP as the ``drain`` op's
         ``undrain`` field (:meth:`ServingClient.undrain`)."""
+        if self.draining:
+            self.journal.append("undrain")
         self.draining = False
 
     def set_role(self, role: str) -> str:
@@ -2103,6 +2112,9 @@ class ServingEngine:
                 f"unknown role {role!r}: expected 'mixed', 'prefill', "
                 f"or 'decode'"
             )
+        if role != self.role:
+            self.journal.append("reconfigure", target=role,
+                                previous=self.role)
         self.role = role
         return role
 
@@ -2171,6 +2183,9 @@ class ServingEngine:
         self.tracer.record(0, "serving.weight_swap", time.monotonic(),
                            0.0, wv=self.weight_version,
                            swap_ms=round(swap_ms, 3))
+        self.journal.append("weight_push",
+                            version=self.weight_version,
+                            swap_ms=round(swap_ms, 3))
         return {"version": self.weight_version,
                 "swap_ms": round(swap_ms, 3)}
 
@@ -3095,11 +3110,14 @@ class ServingEngine:
             if req.first_token_t is None:
                 req.first_token_t = t
                 ttft_ms = (t - req.submit_t) * 1e3
-                self._m_ttft_ms.observe(ttft_ms)
+                self._m_ttft_ms.observe(ttft_ms, exemplar=req.trace_id)
                 self._m_qos_ttft.labels(tier=req.tier).observe(ttft_ms)
             else:
                 itl_ms = (t - req.last_token_t) * 1e3
-                self._m_itl_ms.observe(itl_ms)
+                # the exemplar joins the latency tail back to its
+                # trace: p99 now names a request you can `report
+                # --trace`
+                self._m_itl_ms.observe(itl_ms, exemplar=req.trace_id)
                 self._m_qos_itl.labels(tier=req.tier).observe(itl_ms)
             req.last_token_t = t
             req.stream._put(tok)
@@ -3860,6 +3878,10 @@ class ServingEngine:
             "itl_ms": {
                 "p50": self._m_itl_ms.percentile(50),
                 "p99": self._m_itl_ms.percentile(99),
+                # the most recent tail observation's trace id
+                # ({"value", "trace_id", "le"}, or None before any
+                # exemplar lands) — feed it to `report --trace`
+                "p99_exemplar": self._m_itl_ms.tail_exemplar(),
             },
             "decode_stalls": self._m_decode_stalls.value,
             # device-resident multi-step decode: the configured window

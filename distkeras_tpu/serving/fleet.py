@@ -25,9 +25,12 @@ caring how requests are routed:
 - fleet aggregation — :meth:`ReplicaManager.aggregate_stats` (fleet
   sums + per-replica snapshots), :meth:`~ReplicaManager.aggregate_metrics`
   (per-replica :meth:`MetricRegistry.collect` snapshots merged by
-  :func:`merge_metric_snapshots`), and
-  :meth:`~ReplicaManager.aggregate_alerts` — the payloads of the
-  router's ``stats``/``metrics``/``alerts`` ops.
+  :func:`merge_metric_snapshots`),
+  :meth:`~ReplicaManager.aggregate_alerts`, and the
+  :meth:`~ReplicaManager.collect_timeseries` /
+  :meth:`~ReplicaManager.collect_events` fan-outs — the payloads of
+  the router's ``stats``/``metrics``/``alerts``/``timeseries``/
+  ``events`` ops.
 
 Everything is stdlib-only, like the rest of the serving transport.
 """
@@ -198,6 +201,20 @@ def merge_metric_snapshots(snapshots: Sequence[Dict[str, dict]],
                         have.get("sum", 0.0) + s.get("sum", 0.0), 6)
                     have["count"] = (have.get("count", 0)
                                      + s.get("count", 0))
+                    # exemplars: per bucket, keep the worst (highest
+                    # value) observation across replicas — the fleet
+                    # tail names the trace that actually hurt
+                    he, se = (have.get("exemplars"),
+                              s.get("exemplars"))
+                    if se:
+                        he = dict(he) if he else {}
+                        for le, ex in se.items():
+                            cur_ex = he.get(le)
+                            if (cur_ex is None
+                                    or ex.get("value", 0.0)
+                                    > cur_ex.get("value", 0.0)):
+                                he[le] = dict(ex)
+                        have["exemplars"] = he
     return out
 
 
@@ -536,6 +553,52 @@ class ReplicaManager:
                 continue
             try:
                 out.append(client.trace_dump(trace=trace))
+            except Exception:
+                continue
+        return out
+
+    def collect_timeseries(self, last: Optional[int] = None,
+                           ) -> Dict[str, List[dict]]:
+        """Every routable replica's metric-history points, keyed by
+        replica name — the fan-out leg of fleet time-series
+        collection (the router merges them with its own store via
+        :func:`~distkeras_tpu.telemetry.merge_timeseries`). A replica
+        that fails the fetch, or has its collector disabled, is
+        skipped."""
+        out: Dict[str, List[dict]] = {}
+        for r in self.routable():
+            client = r.client
+            if client is None:
+                continue
+            msg: Dict = {"op": "timeseries"}
+            if last is not None:
+                msg["last"] = int(last)
+            try:
+                out[r.name] = client._call(
+                    msg, timeout=self.probe_timeout
+                )["timeseries"]["points"]
+            except Exception:
+                continue
+        return out
+
+    def collect_events(self, last: Optional[int] = None,
+                       ) -> Dict[str, List[dict]]:
+        """Every routable replica's control-plane journal, keyed by
+        replica name — merged with the router's own journal via
+        :func:`~distkeras_tpu.telemetry.merge_event_journals`. A
+        replica that fails the fetch is skipped."""
+        out: Dict[str, List[dict]] = {}
+        for r in self.routable():
+            client = r.client
+            if client is None:
+                continue
+            msg: Dict = {"op": "events"}
+            if last is not None:
+                msg["last"] = int(last)
+            try:
+                out[r.name] = client._call(
+                    msg, timeout=self.probe_timeout
+                )["events"]["events"]
             except Exception:
                 continue
         return out

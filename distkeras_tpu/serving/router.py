@@ -59,7 +59,11 @@ per-replica health/load gauges live in the router's registry; the
 ``stats`` op answers fleet sums + per-replica snapshots + the router
 section, ``metrics`` merges every replica's registry snapshot with the
 router's own, and ``alerts`` concatenates per-replica SLO alerts
-tagged by replica.
+tagged by replica. The ``timeseries`` op merges every replica's
+metric-history ring with the router's own per time bucket, and the
+``events`` op interleaves the fleet's control-plane journals
+(autoscaling, drains, weight pushes, rollbacks, migrations, replica
+up/down) into one timestamp-ordered story.
 
 Distributed tracing: the router mints ONE fleet-unique trace id per
 request (or honors one the client propagated) and forwards it on every
@@ -92,8 +96,11 @@ from typing import Dict, List, Optional, Sequence, Set, Tuple
 from distkeras_tpu import telemetry
 from distkeras_tpu.networking import recv_msg, send_msg
 from distkeras_tpu.telemetry.chrome import to_chrome_trace
+from distkeras_tpu.telemetry.events import EventJournal, merge_event_journals
+from distkeras_tpu.telemetry.timeseries import TimeSeriesStore, merge_timeseries
 from distkeras_tpu.telemetry.trace import merge_span_chains
 from distkeras_tpu.serving.fleet import (
+    _GAUGE_MAX_FAMILIES,
     DOWN,
     DRAINING,
     HEALTHY,
@@ -351,6 +358,11 @@ class Router:
         self.policy = policy
         self.registry = registry or telemetry.get_registry()
         self.tracer = tracer or telemetry.get_tracer()
+        # control-plane journal (autoscaling, replica up/down, drains,
+        # rollbacks, KV migrations) + router-side metric history; the
+        # `events`/`timeseries` ops merge these with every replica's
+        self.journal = EventJournal(actor="router")
+        self.timeseries = TimeSeriesStore(registry=self.registry)
         built: List[Replica] = []
         for spec in replicas:
             if isinstance(spec, Replica):
@@ -494,6 +506,7 @@ class Router:
 
     def start(self) -> "Router":
         self.manager.start()
+        self.timeseries.start()
         self._sock.listen(128)
         t = threading.Thread(target=self._accept_loop, daemon=True)
         t.start()
@@ -502,6 +515,7 @@ class Router:
 
     def stop(self, timeout: float = 10.0):
         self._stop.set()
+        self.timeseries.stop()
         # shutdown-first: a bare close() would leave the accept loop
         # blocked in accept() until the join timeout
         shutdown_close(self._sock)
@@ -526,6 +540,8 @@ class Router:
             self.index.forget(replica.name)
         self.tracer.record(None, "router.replica_down", time.monotonic(),
                            0.0, replica=replica.name)
+        self.journal.append("replica_down", target=replica.name,
+                            reason="probe_failure")
 
     def _on_replica_drain(self, replica: Replica):
         """A replica entered draining (probe-detected or admin drain):
@@ -702,6 +718,11 @@ class Router:
                 decode_replica=dst.name, bytes=nbytes,
                 migration_ms=round(ms, 3),
             )
+            # "from_replica", not "source": merge_event_journals tags
+            # each event with its originating journal under "source"
+            self.journal.append("kv_migrate", target=dst.name,
+                                outcome=outcome, from_replica=src.name,
+                                trace=entry.trace_id, bytes=nbytes)
         entry.replica, entry.client = dst, dclient
         entry.n_backend = 0
         if self.policy == "affine":
@@ -1053,6 +1074,9 @@ class Router:
             failed=len(pending), rollback=int(is_rollback),
             total_ms=round(total_ms, 3),
         )
+        self.journal.append("weight_push", version=version,
+                            updated=len(updated), failed=len(pending),
+                            outcome=outcome)
         return {"version": version, "updated": updated,
                 "failed": pending, "events": events,
                 "swap_ms": round(swap_ms, 3),
@@ -1103,6 +1127,9 @@ class Router:
             rules=",".join(str(r) for r in rules),
             available=int(prev is not None),
         )
+        self.journal.append("rollback", version=burned_version,
+                            rules=[str(r) for r in rules],
+                            available=int(prev is not None))
         if prev is None:
             self._weights = {**self._weights,
                              "rollbacks":
@@ -1205,6 +1232,21 @@ class Router:
                         # the fleet converged
                         self._op_push_weights(conn, lock, msg,
                                               push_buf)
+                    elif op == "timeseries":
+                        last = (None if msg.get("last") is None
+                                else int(msg["last"]))
+                        self._send(conn, lock, {
+                            "ok": 1,
+                            "timeseries": self.fleet_timeseries(
+                                last=last),
+                        })
+                    elif op == "events":
+                        last = (None if msg.get("last") is None
+                                else int(msg["last"]))
+                        self._send(conn, lock, {
+                            "ok": 1,
+                            "events": self.fleet_events(last=last),
+                        })
                     elif op == "flight":
                         self._send(conn, lock, {
                             "ok": 0,
@@ -1332,6 +1374,8 @@ class Router:
         if undrain:
             reply = client.undrain()
             replica.state = HEALTHY  # routable again immediately
+            self.journal.append("undrain", target=replica.name,
+                                reason="admin")
             self._send(conn, lock, {"ok": 1, "draining": 0,
                                     "replica": replica.name, **reply})
             return
@@ -1341,6 +1385,8 @@ class Router:
         # fires on_drain for transitions IT observes, and this state
         # was just set under its feet
         self.manager.note_drain(replica)
+        self.journal.append("drain", target=replica.name,
+                            reason="admin")
         self._send(conn, lock, {"ok": 1, "draining": 1,
                                 "replica": replica.name, **reply})
 
@@ -1370,6 +1416,8 @@ class Router:
         # (stale role = wrong pool until the next probe)
         if replica.last_stats:
             replica.last_stats["role"] = role
+        self.journal.append("reconfigure", target=replica.name,
+                            role=role)
         self._send(conn, lock, {"ok": 1, "role": role,
                                 "replica": replica.name})
 
@@ -1389,6 +1437,8 @@ class Router:
         self.manager.add(replica)
         with self._route_lock:
             self.ring = _HashRing([r.name for r in self.manager.replicas])
+        self.journal.append("replica_up", target=replica.name,
+                            fleet=len(self.manager.replicas))
         return replica
 
     def remove_replica(self, name: str) -> dict:
@@ -1405,6 +1455,9 @@ class Router:
             self.index.forget(replica.name)
         last = dict(replica.last_stats)
         replica.mark_down("removed from fleet")
+        self.journal.append("replica_down", target=name,
+                            reason="removed",
+                            fleet=len(self.manager.replicas))
         return last
 
     def _op_push_weights(self, conn, lock, msg: dict, buf: dict):
@@ -1568,6 +1621,37 @@ class Router:
             [self.registry.collect()]
             + [self.manager.aggregate_metrics()]
         )
+
+    def fleet_timeseries(self, last: Optional[int] = None) -> dict:
+        """The fleet's metric history: every replica's ring plus the
+        router's own, merged per time bucket by
+        :func:`~distkeras_tpu.telemetry.merge_timeseries` (rates and
+        counts summed, windowed percentiles by MAX, gauges summed
+        except the version/flag families) — the ``timeseries`` op's
+        payload."""
+        per = self.manager.collect_timeseries(last=last)
+        per["router"] = self.timeseries.points(last=last)
+        meta = self.timeseries.meta()
+        meta["sources"] = sorted(per)
+        return {
+            "meta": meta,
+            "points": merge_timeseries(
+                per, bucket_s=self.timeseries.interval_s,
+                max_families=_GAUGE_MAX_FAMILIES),
+        }
+
+    def fleet_events(self, last: Optional[int] = None) -> dict:
+        """The fleet's control-plane journal: router-side events
+        (autoscaling, replica up/down, rollbacks, migrations)
+        interleaved with every replica's own (drains, role flips,
+        weight swaps), each tagged with its ``source`` and
+        timestamp-ordered — the ``events`` op's payload."""
+        per = self.manager.collect_events(last=last)
+        per["router"] = self.journal.events(last=last)
+        meta = self.journal.meta()
+        meta["sources"] = sorted(per)
+        return {"meta": meta,
+                "events": merge_event_journals(per)}
 
     # -- admin conveniences (host-side; the ops above are the wire API) -----
 

@@ -20,6 +20,15 @@ Protocol (all frames are msgpack dicts):
                                               # trace-event JSON
     {"op": "flight", "last"?: n}              # flight-recorder ticks
     {"op": "alerts"}                          # SLO monitor state
+    {"op": "timeseries", "last"?: n}          # metric-history ring
+                                              # (periodic registry
+                                              # deltas: rates, gauge
+                                              # samples, windowed
+                                              # percentiles)
+    {"op": "events", "last"?: n}              # control-plane event
+                                              # journal (drain/undrain,
+                                              # reconfigure, weight
+                                              # swaps, ...)
     {"op": "drain"}                           # close admissions (graceful);
                                               # with "undrain": 1 reopen
                                               # them (rolling updates)
@@ -77,6 +86,9 @@ Protocol (all frames are msgpack dicts):
     {"ok": 1, "chrome": {"traceEvents": [...]}}   # Perfetto-loadable
     {"ok": 1, "flight": {"meta":..,"ticks":[..]}}   # FlightRecorder ring
     {"ok": 1, "alerts": [...]}                # SloMonitor.alerts()
+    {"ok": 1, "timeseries": {"meta":..,"points":[..]}}
+                                              # TimeSeriesStore ring
+    {"ok": 1, "events": {"meta":..,"events":[..]}}   # EventJournal ring
     {"ok": 1, "draining": 1, "active": a, "queued": q}   # drain accepted
     {"ok": 1, "role": r}                      # reconfigure applied
     {"ok": 1, "received": i}                  # push_weights chunk i < k-1
@@ -123,6 +135,7 @@ from distkeras_tpu.serving.weights import (
     serialize_weights,
 )
 from distkeras_tpu.telemetry.chrome import to_chrome_trace
+from distkeras_tpu.telemetry.timeseries import TimeSeriesStore
 
 # serving frames are small (one token or one prompt); cap accordingly
 MAX_SERVE_FRAME_BYTES = 1 << 24  # 16 MiB
@@ -195,14 +208,26 @@ class LMServer:
     (started/stopped with the server; served by the ``alerts`` op), and
     ``watchdog_timeout_s`` arms the engine's stall watchdog — if the
     loop thread stops ticking while work is pending, a flight
-    postmortem is dumped."""
+    postmortem is dumped.
+
+    ``timeseries`` controls the metric-history collector (the
+    ``timeseries`` op): True (the default) samples the engine registry
+    into an own :class:`~distkeras_tpu.telemetry.TimeSeriesStore` on a
+    self-timed collector thread, a store instance shares one, and
+    None/False disables it."""
 
     def __init__(self, engine: ServingEngine, host: str = "127.0.0.1",
                  port: int = 0,
                  max_frame_bytes: int = MAX_SERVE_FRAME_BYTES,
-                 slo=None, watchdog_timeout_s: Optional[float] = None):
+                 slo=None, watchdog_timeout_s: Optional[float] = None,
+                 timeseries=True):
         self.engine = engine
         self.slo = slo
+        if timeseries is True:
+            self.timeseries: Optional[TimeSeriesStore] = TimeSeriesStore(
+                registry=engine.registry)
+        else:
+            self.timeseries = timeseries or None
         self._watchdog = (engine.watchdog(timeout_s=watchdog_timeout_s)
                           if watchdog_timeout_s is not None else None)
         self.max_frame_bytes = max_frame_bytes
@@ -236,6 +261,8 @@ class LMServer:
             self.slo.start()
         if self._watchdog is not None:
             self._watchdog.start()
+        if self.timeseries is not None:
+            self.timeseries.start()
         return self
 
     def stop(self, timeout: float = 10.0):
@@ -244,6 +271,8 @@ class LMServer:
             self._watchdog.stop()
         if self.slo is not None:
             self.slo.stop()
+        if self.timeseries is not None:
+            self.timeseries.stop()
         # shutdown-first on the listener too: a bare close() leaves the
         # accept loop blocked in accept() holding the file description,
         # and its join below would burn the full timeout
@@ -424,6 +453,30 @@ class LMServer:
                                   if self.slo is not None else [])
                         self._send(conn, lock,
                                    {"ok": 1, "alerts": alerts})
+                    elif op == "timeseries":
+                        ts = self.timeseries
+                        if ts is None:
+                            self._send(conn, lock, {
+                                "ok": 0,
+                                "error": "time-series store disabled",
+                            })
+                        else:
+                            last = (None if msg.get("last") is None
+                                    else int(msg["last"]))
+                            self._send(conn, lock, {
+                                "ok": 1, "timeseries": {
+                                    "meta": ts.meta(),
+                                    "points": ts.points(last=last),
+                                }})
+                    elif op == "events":
+                        jr = self.engine.journal
+                        last = (None if msg.get("last") is None
+                                else int(msg["last"]))
+                        self._send(conn, lock, {
+                            "ok": 1, "events": {
+                                "meta": jr.meta(),
+                                "events": jr.events(last=last),
+                            }})
                     elif op == "export_kv":
                         # KV-block migration, the prefill-replica half:
                         # gather the cached blocks covering this
@@ -872,6 +925,31 @@ class ServingClient:
         """SLO alert state per rule (firing first); empty when the
         server has no monitor attached."""
         return list(self._call({"op": "alerts"})["alerts"])
+
+    def timeseries(self, last: Optional[int] = None) -> dict:
+        """The server's metric-history ring: ``{"meta": {...},
+        "points": [...]}`` (most recent ``last`` points when given).
+        Against a :class:`~distkeras_tpu.serving.Router`, the
+        fleet-merged series (each point carries its contributing
+        ``sources``). Raises RuntimeError when the collector is
+        disabled."""
+        msg: dict = {"op": "timeseries"}
+        if last is not None:
+            msg["last"] = int(last)
+        return dict(self._call(msg)["timeseries"])
+
+    def events(self, last: Optional[int] = None) -> dict:
+        """The control-plane event journal: ``{"meta": {...},
+        "events": [...]}`` oldest-first (most recent ``last`` when
+        given). Against a :class:`~distkeras_tpu.serving.Router`, the
+        merged fleet journal — router-side events (autoscaling,
+        replica up/down, rollbacks) interleaved with every replica's
+        own (drains, role flips, weight swaps), each tagged with its
+        ``source``."""
+        msg: dict = {"op": "events"}
+        if last is not None:
+            msg["last"] = int(last)
+        return dict(self._call(msg)["events"])
 
     def export_kv(self, prompt) -> dict:
         """Gather the server's cached KV blocks covering ``prompt``'s

@@ -157,16 +157,23 @@ class CheckpointWatcher:
     checkpoint does not fit the serving model) is recorded in
     ``errors`` and does NOT stop the watcher: the next checkpoint may
     be fine, and a bad artifact must not kill the deploy loop.
+
+    ``journal`` (an :class:`~distkeras_tpu.telemetry.EventJournal`)
+    records each push attempt as a ``weight_push`` control-plane event
+    by outcome — the deploy loop's side of the story the receiving
+    engine/router journals from theirs.
     """
 
     def __init__(self, directory: str, target: Any,
                  interval_s: float = 1.0, like: Optional[dict] = None,
-                 transform: Optional[Callable[[Any], Any]] = None):
+                 transform: Optional[Callable[[Any], Any]] = None,
+                 journal: Optional[Any] = None):
         self.directory = directory
         self.target = target
         self.interval_s = interval_s
         self.like = like
         self.transform = transform
+        self.journal = journal
         self.last_step: Optional[int] = None
         self.pushed = 0
         self.errors: List[Tuple[int, str]] = []
@@ -215,8 +222,16 @@ class CheckpointWatcher:
             self.target.push_weights(variables, version=step)
         except WeightPushError as e:
             self.errors.append((step, str(e)))
+            if self.journal is not None:
+                self.journal.append("weight_push",
+                                    actor="ckpt_watcher",
+                                    version=step, outcome="refused",
+                                    reason=str(e))
             return False
         self.pushed += 1
+        if self.journal is not None:
+            self.journal.append("weight_push", actor="ckpt_watcher",
+                                version=step, outcome="ok")
         return True
 
     def start(self) -> "CheckpointWatcher":
@@ -265,7 +280,8 @@ class ParameterServerFeed:
 
     def __init__(self, ps: Any, target: Any, min_updates: int = 1,
                  interval_s: float = 0.5,
-                 transform: Optional[Callable[[Any], Any]] = None):
+                 transform: Optional[Callable[[Any], Any]] = None,
+                 journal: Optional[Any] = None):
         if min_updates < 1:
             raise ValueError(
                 f"min_updates must be >= 1; got {min_updates}"
@@ -275,6 +291,7 @@ class ParameterServerFeed:
         self.min_updates = min_updates
         self.interval_s = interval_s
         self.transform = transform
+        self.journal = journal
         self.last_pushed_updates = 0
         self.pushed = 0
         self.errors: List[str] = []
@@ -304,6 +321,9 @@ class ParameterServerFeed:
         self.last_pushed_updates = n
         self.target.push_weights(variables, version=n)
         self.pushed += 1
+        if self.journal is not None:
+            self.journal.append("weight_push", actor="ps_feed",
+                                version=n, outcome="ok")
         return True
 
     def start(self) -> "ParameterServerFeed":

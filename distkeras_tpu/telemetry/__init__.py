@@ -31,10 +31,21 @@ Five pieces, one import surface:
   the process-global :data:`~distkeras_tpu.telemetry.runtime.recompiles`
   counter (traced-function bodies note each jit trace), host RSS, and
   device-memory watermarks (``MemoryWatermarks``).
+- :mod:`~distkeras_tpu.telemetry.timeseries` — metric history
+  (``TimeSeriesStore``): a bounded ring of periodic registry deltas
+  (counters→rates, gauges→samples, histograms→windowed p50/p99)
+  sampled by a self-timed collector thread, scraped fleet-wide by the
+  ``timeseries`` op and merged per-replica (``merge_timeseries``).
+- :mod:`~distkeras_tpu.telemetry.events` — the control-plane journal
+  (``EventJournal`` + ``FleetEvent``): every mutating fleet action
+  (scale, drain, reconfigure, weight push/rollback, KV migration)
+  as a typed, timestamped event; the ``events`` op, ``/events``, and
+  ``merge_event_journals`` fold a fleet into one causal story.
 - :mod:`~distkeras_tpu.telemetry.exposition` — the scrape side:
-  Prometheus text rendering and a stdlib-HTTP ``TelemetryServer``
-  (``/metrics``, ``/metrics.json``, ``/traces``, ``/flight``,
-  ``/alerts``, ``/healthz``).
+  Prometheus text rendering (OpenMetrics exemplars opt-in) and a
+  stdlib-HTTP ``TelemetryServer`` (``/metrics``, ``/metrics.json``,
+  ``/traces``, ``/flight``, ``/alerts``, ``/timeseries``,
+  ``/events``, ``/healthz``).
 
 Offline analysis: ``python -m distkeras_tpu.telemetry.report trace.jsonl``
 for span timelines, ``... report --flight dump.jsonl`` for tick
@@ -48,6 +59,12 @@ from distkeras_tpu.telemetry.chrome import (  # noqa: F401
     chrome_trace_events,
     to_chrome_trace,
     write_chrome_trace,
+)
+from distkeras_tpu.telemetry.events import (  # noqa: F401
+    KNOWN_ACTIONS,
+    EventJournal,
+    FleetEvent,
+    merge_event_journals,
 )
 from distkeras_tpu.telemetry.exposition import (  # noqa: F401
     TelemetryServer,
@@ -74,10 +91,18 @@ from distkeras_tpu.telemetry.runtime import (  # noqa: F401
     recompiles,
 )
 from distkeras_tpu.telemetry.slo import (  # noqa: F401
+    AnomalyRule,
     SloMonitor,
     SloRule,
     StallWatchdog,
+    default_anomaly_rules,
     default_serving_rules,
+)
+from distkeras_tpu.telemetry.timeseries import (  # noqa: F401
+    TimeSeriesStore,
+    merge_timeseries,
+    series_key,
+    write_timeline,
 )
 from distkeras_tpu.telemetry.trace import (  # noqa: F401
     CRITICAL_PATH_PHASES,
@@ -109,8 +134,18 @@ __all__ = [
     "POSTMORTEM_PREFIX",
     "SloMonitor",
     "SloRule",
+    "AnomalyRule",
     "StallWatchdog",
     "default_serving_rules",
+    "default_anomaly_rules",
+    "TimeSeriesStore",
+    "merge_timeseries",
+    "series_key",
+    "write_timeline",
+    "EventJournal",
+    "FleetEvent",
+    "KNOWN_ACTIONS",
+    "merge_event_journals",
     "RecompileCounter",
     "MemoryWatermarks",
     "recompiles",

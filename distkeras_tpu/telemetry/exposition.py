@@ -10,7 +10,9 @@ otherwise (same hardening posture as :class:`ParameterServerService`).
 
 Routes:
 
-    /metrics        Prometheus text exposition format (text/plain)
+    /metrics        Prometheus text exposition format (text/plain);
+                    ?openmetrics=1 switches to OpenMetrics rendering
+                    with ``# {trace_id="..."}`` histogram exemplars
     /metrics.json   the same snapshot as JSON
     /traces         recent spans as JSON; ?trace=<id> filters one
                     request, ?limit=<n> truncates
@@ -22,6 +24,12 @@ Routes:
                     the most recent n; 404 when no recorder is wired
     /alerts         SLO monitor state as JSON (firing rules first);
                     404 when no monitor is wired
+    /timeseries     TimeSeriesStore ring as JSON ({"meta": ...,
+                    "points": [...]}); ?last=<n> keeps the most recent
+                    n; 404 when no store is wired
+    /events         control-plane EventJournal as JSON ({"meta": ...,
+                    "events": [...]}); ?last=<n> as above; 404 when no
+                    journal is wired
     /healthz        200 "ok" (liveness probe)
 """
 
@@ -59,8 +67,23 @@ def _fmt_value(v: float) -> str:
     return repr(int(f)) if f == int(f) else repr(f)
 
 
-def render_prometheus(registry: Optional[MetricRegistry] = None) -> str:
-    """The registry as Prometheus text exposition format v0.0.4."""
+def _fmt_exemplar(ex: dict) -> str:
+    """One OpenMetrics exemplar suffix: ``# {trace_id="..."} value``.
+    Labels escape exactly like series labels (exemplar values are
+    user-supplied trace ids — quotes/backslashes must round-trip)."""
+    return (f' # {{trace_id="{_escape_label(str(ex["trace_id"]))}"}}'
+            f' {_fmt_value(ex["value"])}')
+
+
+def render_prometheus(registry: Optional[MetricRegistry] = None,
+                      openmetrics: bool = False) -> str:
+    """The registry as Prometheus text exposition format v0.0.4.
+
+    ``openmetrics=True`` appends histogram-bucket exemplars in
+    OpenMetrics syntax (``... # {trace_id="..."} value``). The default
+    stays plain v0.0.4 — classic Prometheus text parsers reject the
+    ``#`` suffix mid-line, so exemplars are strictly opt-in and the
+    default output is byte-identical to the pre-exemplar renderer."""
     registry = registry or get_registry()
     lines = []
     for name, snap in sorted(registry.collect().items()):
@@ -72,12 +95,16 @@ def render_prometheus(registry: Optional[MetricRegistry] = None) -> str:
             if snap["type"] == "histogram":
                 # buckets are already cumulative-ready counts per bucket;
                 # Prometheus wants cumulative le= counts
+                exemplars = series.get("exemplars", {}) if openmetrics \
+                    else {}
                 cum = 0
                 for le, c in series["buckets"].items():
                     cum += c
+                    ex = exemplars.get(le)
                     lines.append(
                         f"{name}_bucket"
                         f"{_fmt_labels(labels, {'le': le})} {cum}"
+                        + (_fmt_exemplar(ex) if ex else "")
                     )
                 lines.append(
                     f"{name}_sum{_fmt_labels(labels)} "
@@ -108,13 +135,17 @@ class TelemetryServer:
     def __init__(self, registry: Optional[MetricRegistry] = None,
                  tracer: Optional[Tracer] = None,
                  host: str = "127.0.0.1", port: int = 0,
-                 flight=None, slo=None):
+                 flight=None, slo=None, timeseries=None, events=None):
         self.registry = registry or get_registry()
         self.tracer = tracer or get_tracer()
         # optional panes: a FlightRecorder for /flight, an SloMonitor
-        # for /alerts (404 when not wired — scrape configs can probe)
+        # for /alerts, a TimeSeriesStore for /timeseries, an
+        # EventJournal for /events (404 when not wired — scrape
+        # configs can probe)
         self.flight = flight
         self.slo = slo
+        self.timeseries = timeseries
+        self.events = events
         outer = self
 
         class _Handler(BaseHTTPRequestHandler):
@@ -134,9 +165,14 @@ class TelemetryServer:
                 q = parse_qs(url.query)
                 try:
                     if url.path == "/metrics":
+                        om = q.get("openmetrics", ["0"])[0] not in (
+                            "0", "", "false")
                         self._reply(
-                            200, render_prometheus(outer.registry),
-                            "text/plain; version=0.0.4",
+                            200,
+                            render_prometheus(outer.registry,
+                                              openmetrics=om),
+                            ("application/openmetrics-text" if om
+                             else "text/plain; version=0.0.4"),
                         )
                     elif url.path == "/metrics.json":
                         self._reply(
@@ -190,6 +226,38 @@ class TelemetryServer:
                             self._reply(200,
                                         json.dumps(outer.slo.alerts()),
                                         "application/json")
+                    elif url.path == "/timeseries":
+                        if outer.timeseries is None:
+                            self._reply(404, "no time-series store",
+                                        "text/plain")
+                        else:
+                            last = (int(q["last"][0])
+                                    if "last" in q else None)
+                            self._reply(
+                                200,
+                                json.dumps({
+                                    "meta": outer.timeseries.meta(),
+                                    "points": outer.timeseries.points(
+                                        last=last),
+                                }),
+                                "application/json",
+                            )
+                    elif url.path == "/events":
+                        if outer.events is None:
+                            self._reply(404, "no event journal",
+                                        "text/plain")
+                        else:
+                            last = (int(q["last"][0])
+                                    if "last" in q else None)
+                            self._reply(
+                                200,
+                                json.dumps({
+                                    "meta": outer.events.meta(),
+                                    "events": outer.events.events(
+                                        last=last),
+                                }),
+                                "application/json",
+                            )
                     elif url.path == "/healthz":
                         self._reply(200, "ok", "text/plain")
                     else:

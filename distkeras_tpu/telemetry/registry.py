@@ -62,8 +62,8 @@ class _Bound:
     def set(self, value: float):
         self._metric._set(self._key, value)
 
-    def observe(self, value: float):
-        self._metric._observe(self._key, value)
+    def observe(self, value: float, exemplar: Optional[str] = None):
+        self._metric._observe(self._key, value, exemplar)
 
     @property
     def value(self):
@@ -110,8 +110,8 @@ class _Metric:
     def set(self, value: float):
         self._set(self._unlabeled(), value)
 
-    def observe(self, value: float):
-        self._observe(self._unlabeled(), value)
+    def observe(self, value: float, exemplar: Optional[str] = None):
+        self._observe(self._unlabeled(), value, exemplar)
 
     @property
     def value(self):
@@ -125,18 +125,31 @@ class _Metric:
     def _set(self, key, value):
         raise TypeError(f"{self.kind} does not support set()")
 
-    def _observe(self, key, value):
+    def _observe(self, key, value, exemplar=None):
         raise TypeError(f"{self.kind} does not support observe()")
 
     def _value(self, key):
         with self._lock:
             return self._series.get(key, 0.0)
 
+    def _copy_state(self, state):
+        """A consistent copy of one series' state, taken while the
+        metric lock is held. Scalar states (counter/gauge) are already
+        immutable; histograms override with a deep copy so rendering
+        OUTSIDE the lock can never see a torn write (the same
+        torn-read shape ``FlightRecorder.meta()`` fixed: bucket counts
+        from one observe, sum/count from the next)."""
+        return state
+
     def snapshot(self) -> dict:
         """Plain-data view: {"type", "help", "labelnames", "series":
-        [{"labels": {...}, ...state...}]}."""
+        [{"labels": {...}, ...state...}]}. Per-series state is copied
+        in the SAME lock hold that reads the series map, so every
+        rendered series is internally consistent under concurrent
+        writes."""
         with self._lock:
-            items = list(self._series.items())
+            items = [(key, self._copy_state(state))
+                     for key, state in self._series.items()]
         return {
             "type": self.kind,
             "help": self.help,
@@ -193,34 +206,47 @@ class Histogram(_Metric):
             raise ValueError(f"{self.name}: need at least one bucket")
         self.buckets = b
 
-    def _observe(self, key, value):
+    def _observe(self, key, value, exemplar=None):
         v = float(value)
         with self._lock:
             state = self._series.get(key)
             if state is None:
-                # [per-bucket counts (+Inf last), sum, count]
-                state = [[0] * (len(self.buckets) + 1), 0.0, 0]
+                # [per-bucket counts (+Inf last), sum, count,
+                #  {bucket index: (value, exemplar)}]
+                state = [[0] * (len(self.buckets) + 1), 0.0, 0, {}]
                 self._series[key] = state
-            counts, _, _ = state
+            counts = state[0]
+            idx = len(self.buckets)  # +Inf unless a bound catches it
             for i, ub in enumerate(self.buckets):
                 if v <= ub:
-                    counts[i] += 1
+                    idx = i
                     break
-            else:
-                counts[-1] += 1
+            counts[idx] += 1
             state[1] += v
             state[2] += 1
+            if exemplar is not None:
+                # most-recent exemplar per bucket: the trace id that
+                # LAST landed here, so the tail's exemplar is still
+                # resolvable in the bounded trace archives (an all-time
+                # max would name a long-evicted chain)
+                state[3][idx] = (v, str(exemplar))
 
     def _value(self, key):
         with self._lock:
             state = self._series.get(key)
             if state is None:
                 return None
+            state = self._copy_state(state)
         return self._render_state(state)
 
+    def _copy_state(self, state):
+        # deep enough that concurrent observes can't tear the render:
+        # counts list and exemplar dict are the mutated containers
+        return [list(state[0]), state[1], state[2], dict(state[3])]
+
     def _render_state(self, state) -> dict:
-        counts, total, n = state
-        return {
+        counts, total, n, exemplars = state
+        out = {
             "buckets": {
                 **{repr(ub): c for ub, c in zip(self.buckets, counts)},
                 "+Inf": counts[-1],
@@ -228,6 +254,28 @@ class Histogram(_Metric):
             "sum": round(total, 6),
             "count": n,
         }
+        if exemplars:
+            le = [repr(ub) for ub in self.buckets] + ["+Inf"]
+            out["exemplars"] = {
+                le[i]: {"value": v, "trace_id": x}
+                for i, (v, x) in sorted(exemplars.items())
+            }
+        return out
+
+    def tail_exemplar(self, **labels) -> Optional[dict]:
+        """The exemplar from the highest populated bucket — the trace
+        id that names this series' current tail (``stats()`` surfaces
+        it next to p99). None until an exemplar-bearing observation
+        landed. One lock hold, like :meth:`percentile`."""
+        key = (tuple(str(labels[n]) for n in self.labelnames)
+               if labels else self._unlabeled())
+        with self._lock:
+            state = self._series.get(key)
+            if state is None or not state[3]:
+                return None
+            idx, (v, x) = max(state[3].items())
+        le = [repr(ub) for ub in self.buckets] + ["+Inf"]
+        return {"value": v, "trace_id": x, "le": le[idx]}
 
     def percentile(self, p: float, **labels) -> Optional[float]:
         """Bucket-interpolated percentile estimate (the exact-value
@@ -243,7 +291,12 @@ class Histogram(_Metric):
             state = self._series.get(key)
             if state is None or state[2] == 0:
                 return None
-            counts, _, n = [list(state[0]), state[1], state[2]]
+            # bucket counts and total count in ONE lock hold: a copy
+            # taken across two acquisitions could see counts from one
+            # observe and n from the next (the FlightRecorder.meta
+            # torn-read shape), and the interpolation below would
+            # then walk past the real distribution
+            counts, n = list(state[0]), state[2]
         if sum(counts[:-1]) == 0:  # nothing landed in a finite bucket
             return None
         rank = n * p / 100.0
@@ -312,8 +365,15 @@ class MetricRegistry:
 
     def collect(self) -> Dict[str, dict]:
         """Plain-data snapshot of every registered metric — the payload
-        of the msgpack ``stats`` ops and ``/metrics.json``."""
-        return {m.name: m.snapshot() for m in self.metrics()}
+        of the msgpack ``stats`` ops and ``/metrics.json``. The
+        name → metric map is captured in one registry-lock hold (a
+        concurrent registration lands wholly before or wholly after
+        this snapshot, never half-iterated), then each metric renders
+        itself under its own lock — no nested lock holds, so a slow
+        histogram render never blocks registration."""
+        with self._lock:
+            items = list(self._metrics.items())
+        return {name: m.snapshot() for name, m in items}
 
 
 _global_registry = MetricRegistry()

@@ -5,6 +5,8 @@
     python -m distkeras_tpu.telemetry.report /tmp/trace.jsonl --top 5
     python -m distkeras_tpu.telemetry.report /tmp/trace.jsonl --chrome-trace out.json
     python -m distkeras_tpu.telemetry.report --flight /tmp/distkeras-postmortem-*.jsonl
+    python -m distkeras_tpu.telemetry.report --timeline /tmp/timeline.jsonl
+    python -m distkeras_tpu.telemetry.report --live http://127.0.0.1:9100 --polls 3
 
 Span mode input is what :class:`~distkeras_tpu.telemetry.trace.Tracer`
 mirrors to ``path=`` (or a saved ``trace_dump`` / ``/traces`` response,
@@ -32,9 +34,26 @@ token-budget split, per-phase latency (host-plan / device / stream), and
 per-slot state — plus a phase breakdown and the slowest ticks, which is
 the "why did tick 48211 take 300 ms?" view.
 
-A missing, unreadable, or corrupt input file exits with status 2 and a
-one-line error — no traceback; dumps come from crashing processes, and
-the tool reading them must not crash too.
+``--timeline`` mode renders a time-series timeline artifact
+(:func:`~distkeras_tpu.telemetry.timeseries.write_timeline` output, or
+a hand-rolled JSONL of ``{"point": ...}`` / ``{"event": ...}`` lines):
+sparklines for the most interesting series over the covered span, an
+event ruler marking where control-plane actions landed, and the merged
+journal interleaved in timestamp order — each event row annotated with
+the headline series values at that moment. That is the forensic join
+the flat files cannot give: *the autoscaler scaled up at +3.2 s; what
+was p99 ITL doing right then?*
+
+``--live URL`` polls a running
+:class:`~distkeras_tpu.telemetry.exposition.TelemetryServer` (its
+``/timeseries`` and ``/events`` routes — on a router-backed server
+those are already fleet-merged) and renders the same view per poll.
+``--polls N`` bounds the loop (default: forever, ctrl-C to stop).
+
+A missing, unreadable, or corrupt input file — or an unreachable /
+unwired ``--live`` endpoint — exits with status 2 and a one-line
+error — no traceback; dumps come from crashing processes, and the
+tool reading them must not crash too.
 """
 
 from __future__ import annotations
@@ -398,14 +417,242 @@ def report_flight(path: str, last: Optional[int] = None,
                   f"{final['recompiles']}\n")
 
 
+# -- time-series timelines ---------------------------------------------------
+
+_SPARK = "▁▂▃▄▅▆▇█"
+_TL_WIDTH = 60
+# default series picks, most interesting first: windowed tails, then
+# rates, then gauges; :count and :p50 only when explicitly asked for
+_SERIES_RANK = ((":p99", 0), (":rate", 1))
+
+
+def _series_rank(key: str) -> int:
+    for suffix, rank in _SERIES_RANK:
+        if key.endswith(suffix):
+            return rank
+    if ":" not in key.rsplit("}", 1)[-1]:
+        return 2  # gauge (no reduction suffix after the label block)
+    return 3
+
+
+def _sparkline(samples: List, t0: float, t1: float,
+               width: int) -> str:
+    """Bucket (t, value) samples onto a fixed-width column axis and
+    render one block-character sparkline (empty columns stay blank)."""
+    cols: List[List[float]] = [[] for _ in range(width)]
+    span = max(t1 - t0, 1e-9)
+    for t, v in samples:
+        c = min(int((t - t0) / span * width), width - 1)
+        cols[c].append(float(v))
+    flat = [v for col in cols for v in col]
+    lo, hi = min(flat), max(flat)
+    rng = hi - lo
+    out = []
+    for col in cols:
+        if not col:
+            out.append(" ")
+            continue
+        v = sum(col) / len(col)
+        i = int((v - lo) / rng * (len(_SPARK) - 1)) if rng > 0 else 0
+        out.append(_SPARK[i])
+    return "".join(out)
+
+
+def _fmt_val(v: float) -> str:
+    if isinstance(v, float) and v != int(v):
+        return f"{v:.2f}"
+    return str(int(v))
+
+
+def render_fleet_timeline(points: List[dict], events: List[dict],
+                          meta: Optional[dict] = None,
+                          series: Optional[List[str]] = None,
+                          top: int = 8, width: int = _TL_WIDTH,
+                          out: Optional[TextIO] = None):
+    """The series-plus-journal join, three stanzas: sparklines over
+    the covered span, an event ruler on the same column axis, and the
+    journal interleaved in time order with each event row annotated
+    with the headline series values at (or just before) its moment."""
+    out = out or sys.stdout
+    for i, p in enumerate(points, 1):
+        if "t" not in p or not isinstance(p.get("series"), dict):
+            raise ReportError(
+                f"point record {i}: missing t/series keys — is this a "
+                f"timeline JSONL? (see timeseries.write_timeline)"
+            )
+    for i, e in enumerate(events, 1):
+        if "t" not in e or "action" not in e:
+            raise ReportError(
+                f"event record {i}: missing t/action keys — not a "
+                f"FleetEvent journal entry"
+            )
+    points = sorted(points, key=lambda p: p["t"])
+    events = sorted(events, key=lambda e: e["t"])
+    stamps = ([p["t"] for p in points] + [e["t"] for e in events])
+    t0, t1 = min(stamps), max(stamps)
+    srcs = sorted({s for p in points for s in p.get("sources", [])})
+    head = (f"timeline: {len(points)} points, {len(events)} events "
+            f"over {t1 - t0:.1f} s")
+    if srcs:
+        head += f"  [sources: {','.join(srcs)}]"
+    if meta:
+        extras = {k: meta[k] for k in ("interval_s", "dropped")
+                  if meta.get(k)}
+        if extras:
+            head += "  " + " ".join(f"{k}={v}"
+                                    for k, v in extras.items())
+    out.write(head + "\n")
+
+    # pick the series worth sparklining: explicit --series substrings,
+    # else the top-N by (tail/rate/gauge rank, coverage)
+    coverage: Dict[str, int] = defaultdict(int)
+    for p in points:
+        for k in p["series"]:
+            coverage[k] += 1
+    if series:
+        chosen = [k for k in sorted(coverage)
+                  if any(want in k for want in series)]
+        if not chosen:
+            raise ReportError(
+                "--series matched none of "
+                f"{len(coverage)} series in the input"
+            )
+    else:
+        ranked = sorted(coverage,
+                        key=lambda k: (_series_rank(k), -coverage[k],
+                                       k))
+        chosen = sorted(ranked[:top])
+    label_w = max((len(k) for k in chosen), default=10)
+    for key in chosen:
+        samples = [(p["t"], p["series"][key]) for p in points
+                   if key in p["series"]]
+        if not samples:
+            continue
+        vals = [v for _, v in samples]
+        out.write(
+            f"  {key:<{label_w}} "
+            f"{_sparkline(samples, t0, t1, width)} "
+            f"{_fmt_val(min(vals))}..{_fmt_val(max(vals))}\n"
+        )
+    hidden = len(coverage) - len(chosen)
+    if hidden > 0 and not series:
+        out.write(f"  ... {hidden} more series (--series to choose)\n")
+    if events:
+        # the ruler: where on the sparkline axis each action landed
+        ruler = [" "] * width
+        span = max(t1 - t0, 1e-9)
+        for e in events:
+            c = min(int((e["t"] - t0) / span * width), width - 1)
+            ruler[c] = "*" if ruler[c] == " " else "+"
+        out.write(f"  {'events':<{label_w}} {''.join(ruler)}\n")
+    # the interleave: journal rows in time order, each annotated with
+    # the chosen series' values at the nearest point at-or-before t
+    anno_keys = chosen[:3]
+    pi = 0
+    for e in events:
+        while pi + 1 < len(points) and points[pi + 1]["t"] <= e["t"]:
+            pi += 1
+        at = (points[pi]["series"]
+              if points and points[pi]["t"] <= e["t"] else {})
+        detail = {k: v for k, v in e.items()
+                  if k not in ("t", "actor", "action", "target")}
+        anno = " ".join(f"{k}={_fmt_val(at[k])}" for k in anno_keys
+                        if k in at)
+        out.write(
+            f"  +{e['t'] - t0:7.1f}s [{e.get('actor', '?'):<10}] "
+            f"{e['action']:<12} {str(e.get('target') or '-'):<10}"
+            + ("  " + " ".join(f"{k}={v}"
+                               for k, v in sorted(detail.items()))
+               if detail else "")
+            + (f"  | {anno}" if anno else "")
+            + "\n"
+        )
+
+
+def report_timeline(path: str, series: Optional[List[str]] = None,
+                    top: int = 8, out: Optional[TextIO] = None):
+    """Render a ``write_timeline`` artifact (meta line plus ``point``
+    / ``event`` JSONL records)."""
+    recs = _load_jsonl(path)
+    meta = next((r["timeline_meta"] for r in recs
+                 if "timeline_meta" in r), None)
+    points = [r["point"] for r in recs if "point" in r]
+    events = [r["event"] for r in recs if "event" in r]
+    if not points and not events:
+        raise ReportError(
+            f"{path}: no point or event records — is this a trace "
+            f"JSONL? (run without --timeline)"
+        )
+    try:
+        render_fleet_timeline(points, events, meta=meta,
+                              series=series, top=top, out=out)
+    except ReportError as e:
+        raise ReportError(f"{path}: {e}") from None
+
+
+def report_live(url: str, polls: Optional[int] = None,
+                interval_s: float = 2.0,
+                series: Optional[List[str]] = None, top: int = 8,
+                out: Optional[TextIO] = None):
+    """Poll a running TelemetryServer's ``/timeseries`` + ``/events``
+    routes and render the timeline per poll. On a router-backed
+    server the routes are already fleet-merged, so this is the live
+    whole-fleet view. ``polls=None`` loops until interrupted."""
+    import time
+    import urllib.error
+    import urllib.request
+
+    out = out or sys.stdout
+    base = url if "://" in url else "http://" + url
+    base = base.rstrip("/")
+
+    def fetch(route: str) -> dict:
+        try:
+            with urllib.request.urlopen(base + route, timeout=5) as r:
+                doc = json.loads(r.read().decode())
+        except urllib.error.HTTPError as e:
+            raise ReportError(
+                f"{base}{route}: HTTP {e.code} — is the store wired? "
+                f"(TelemetryServer(..., timeseries=, events=))"
+            ) from None
+        except (OSError, ValueError) as e:
+            raise ReportError(
+                f"cannot poll {base}{route}: "
+                f"{getattr(e, 'reason', None) or e}"
+            ) from None
+        if not isinstance(doc, dict):
+            raise ReportError(f"{base}{route}: not a JSON object")
+        return doc
+
+    n = 0
+    while polls is None or n < polls:
+        if n:
+            time.sleep(interval_s)
+            out.write("\n")
+        n += 1
+        ts = fetch("/timeseries")
+        ev = fetch("/events")
+        points = ts.get("points", [])
+        events = ev.get("events", [])
+        if not points and not events:
+            out.write(f"{base}: no points or events yet "
+                      f"(poll {n})\n")
+            continue
+        render_fleet_timeline(points, events, meta=ts.get("meta"),
+                              series=series, top=top, out=out)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description="Render a telemetry trace JSONL into per-request "
                     "timelines and a span summary table, or a "
                     "flight-recorder dump into a tick timeline."
     )
-    ap.add_argument("path", help="trace JSONL (Tracer path= mirror) or, "
-                                 "with --flight, a FlightRecorder dump")
+    ap.add_argument("path", nargs="?", default=None,
+                    help="trace JSONL (Tracer path= mirror); with "
+                         "--flight a FlightRecorder dump; with "
+                         "--timeline a write_timeline artifact "
+                         "(omit with --live)")
     ap.add_argument("--trace", type=int, default=None,
                     help="render only this trace id")
     ap.add_argument("--top", type=int, default=10,
@@ -421,9 +668,35 @@ def main(argv=None):
     ap.add_argument("--last", type=int, default=None,
                     help="flight mode: show only the most recent N ticks "
                          "(summary still covers the whole dump)")
+    ap.add_argument("--timeline", action="store_true",
+                    help="input is a time-series timeline artifact "
+                         "(timeseries.write_timeline output): render "
+                         "sparklines + the event journal interleaved")
+    ap.add_argument("--live", metavar="URL", default=None,
+                    help="poll a running TelemetryServer's "
+                         "/timeseries and /events routes and render "
+                         "the timeline per poll (no path argument)")
+    ap.add_argument("--series", action="append", default=None,
+                    metavar="SUBSTR",
+                    help="timeline/live: sparkline only series whose "
+                         "key contains SUBSTR (repeatable)")
+    ap.add_argument("--polls", type=int, default=None,
+                    help="live mode: stop after N polls "
+                         "(default: poll until interrupted)")
+    ap.add_argument("--poll-interval", type=float, default=2.0,
+                    help="live mode: seconds between polls "
+                         "(default 2)")
     args = ap.parse_args(argv)
+    if args.live is None and args.path is None:
+        ap.error("a JSONL path is required (or use --live URL)")
     try:
-        if args.flight:
+        if args.live is not None:
+            report_live(args.live, polls=args.polls,
+                        interval_s=args.poll_interval,
+                        series=args.series)
+        elif args.timeline:
+            report_timeline(args.path, series=args.series)
+        elif args.flight:
             report_flight(args.path, last=args.last)
         elif args.chrome_trace is not None:
             from distkeras_tpu.telemetry.chrome import write_chrome_trace
@@ -446,6 +719,8 @@ def main(argv=None):
     except ReportError as e:
         print(f"error: {e}", file=sys.stderr)
         sys.exit(2)
+    except KeyboardInterrupt:  # ctrl-C out of --live: clean exit
+        pass
     except BrokenPipeError:  # `... | head` closed the pipe: not an error
         import os
 

@@ -36,6 +36,7 @@ Stdlib-only, like the rest of the package.
 
 from __future__ import annotations
 
+import math
 import threading
 import time
 from dataclasses import dataclass
@@ -95,6 +96,99 @@ class SloRule:
             )
 
 
+@dataclass(frozen=True)
+class AnomalyRule:
+    """A self-calibrating sibling of :class:`SloRule`: instead of a
+    fixed threshold, the rule learns its metric's own trailing
+    behavior (EWMA mean + EWMA variance) and breaches when a sample
+    deviates more than ``z_threshold`` standard deviations from it —
+    "p99 ITL deviated 4σ from its own trailing hour" needs no
+    per-deployment bound. Samples are read exactly like SloRule
+    (histogram percentile / gauge / counter rate), breach verdicts
+    feed the same multi-window burn machinery, and firings surface
+    through the same ``slo_alert_active`` / ``slo_alerts_total``
+    metrics — so the autoscaler's burn inputs pick anomalies up with
+    zero new plumbing.
+
+    Args:
+      name: rule id (the ``rule`` label on the alert metrics; include
+        ``itl``/``ttft`` in the name for the autoscaler's burn-flag
+        matching to see it).
+      metric/kind/labels/windows/burn_threshold: as on SloRule.
+      ewma_alpha: smoothing factor for the trailing mean/variance
+        (higher = faster to forget; 0.05 ≈ a trailing window of ~20
+        samples dominating the estimate).
+      z_threshold: |sample − mean| / std above this is a breach.
+      min_samples: calibration warmup — no verdicts (and so no
+        firings) until this many samples trained the estimator.
+    """
+
+    name: str
+    metric: str
+    kind: str = "gauge"
+    labels: Optional[Tuple[Tuple[str, str], ...]] = None
+    ewma_alpha: float = 0.05
+    z_threshold: float = 4.0
+    min_samples: int = 20
+    windows: Tuple[float, float] = (30.0, 120.0)
+    burn_threshold: float = 0.5
+
+    def __post_init__(self):
+        if self.kind not in ("p50", "p90", "p99", "gauge", "rate"):
+            raise ValueError(
+                f"rule {self.name!r}: kind must be p50/p90/p99/gauge/"
+                f"rate; got {self.kind!r}"
+            )
+        if not 0.0 < self.ewma_alpha <= 1.0:
+            raise ValueError(
+                f"rule {self.name!r}: ewma_alpha must be in (0, 1]; "
+                f"got {self.ewma_alpha}"
+            )
+        if self.z_threshold <= 0:
+            raise ValueError(
+                f"rule {self.name!r}: z_threshold must be > 0; "
+                f"got {self.z_threshold}"
+            )
+        if self.min_samples < 2:
+            raise ValueError(
+                f"rule {self.name!r}: min_samples must be >= 2; "
+                f"got {self.min_samples}"
+            )
+        if not self.windows or any(w <= 0 for w in self.windows):
+            raise ValueError(
+                f"rule {self.name!r}: windows must be positive; "
+                f"got {self.windows}"
+            )
+        if not 0.0 < self.burn_threshold <= 1.0:
+            raise ValueError(
+                f"rule {self.name!r}: burn_threshold must be in (0, 1]; "
+                f"got {self.burn_threshold}"
+            )
+
+
+def default_anomaly_rules(z_threshold: float = 4.0,
+                          min_samples: int = 20,
+                          windows: Tuple[float, float] = (30.0, 120.0),
+                          burn_threshold: float = 0.5,
+                          ) -> List["AnomalyRule"]:
+    """Deviation twins of the default serving objectives: tail
+    latencies (ITL/TTFT p99), queue depth, and block-pool occupancy,
+    each judged against its own trailing behavior. Names
+    carry the ``_anomaly`` suffix (one alert-label namespace with the
+    threshold rules) and keep the ``itl``/``ttft`` substrings the
+    autoscaler's burn matching looks for."""
+    kw = dict(z_threshold=z_threshold, min_samples=min_samples,
+              windows=windows, burn_threshold=burn_threshold)
+    return [
+        AnomalyRule("itl_p99_anomaly", "serving_itl_ms", "p99", **kw),
+        AnomalyRule("ttft_p99_anomaly", "serving_ttft_ms", "p99", **kw),
+        AnomalyRule("queue_depth_anomaly", "serving_queue_depth",
+                    "gauge", **kw),
+        AnomalyRule("blocks_in_use_anomaly", "serving_blocks_in_use",
+                    "gauge", **kw),
+    ]
+
+
 def default_serving_rules(itl_p99_ms: float = 200.0,
                           ttft_p99_ms: float = 2000.0,
                           max_queue_depth: float = 64.0,
@@ -114,7 +208,12 @@ def default_serving_rules(itl_p99_ms: float = 200.0,
 class SloMonitor:
     """Samples a rule set against a registry; call :meth:`poll` on a
     cadence (or :meth:`start` a daemon thread that does). ``now`` and
-    ``dt`` injection on ``poll`` exists for deterministic tests."""
+    ``dt`` injection on ``poll`` exists for deterministic tests.
+
+    Rules may mix :class:`SloRule` (fixed threshold) and
+    :class:`AnomalyRule` (self-calibrating EWMA/z-score deviation) —
+    both kinds share the sampling kinds, the burn windows, the alert
+    metrics, and the :meth:`alerts` surface."""
 
     def __init__(self, rules: Sequence[SloRule],
                  registry: Optional[MetricRegistry] = None,
@@ -134,6 +233,9 @@ class SloMonitor:
         self._value: Dict[str, Optional[float]] = dict.fromkeys(names)
         self._last_counter: Dict[str, Tuple[float, float]] = {}
         self._firing: Dict[str, Optional[float]] = dict.fromkeys(names)
+        # anomaly detector state per AnomalyRule:
+        # [ewma mean, ewma variance, samples trained, last z]
+        self._anomaly: Dict[str, list] = {}
         self._m_active = self.registry.gauge(
             "slo_alert_active", "1 while the rule's alert is firing",
             labelnames=("rule",))
@@ -195,8 +297,10 @@ class SloMonitor:
                 if v is not None:
                     self._m_value.labels(rule=rule.name).set(v)
                 samples = self._samples[rule.name]
-                if v is not None:
-                    samples.append((now, v > rule.threshold))
+                verdict = (self._judge(rule, v)
+                           if v is not None else None)
+                if verdict is not None:
+                    samples.append((now, verdict))
                 horizon = now - max(rule.windows)
                 while samples and samples[0][0] < horizon:
                     samples.pop(0)
@@ -212,13 +316,51 @@ class SloMonitor:
                     self._m_active.labels(rule=rule.name).set(1)
                     self.tracer.record(0, "slo.alert", now, 0.0,
                                        rule=rule.name, value=v,
-                                       threshold=rule.threshold)
+                                       threshold=getattr(
+                                           rule, "threshold", None))
                 elif not firing and was:
                     self._firing[rule.name] = None
                     self._m_active.labels(rule=rule.name).set(0)
                     self.tracer.record(0, "slo.resolve", now, 0.0,
                                        rule=rule.name, value=v)
             return self._alerts_locked(now)
+
+    def _judge(self, rule, v: float) -> Optional[bool]:
+        """One sample's breach verdict. Threshold rules compare
+        directly; anomaly rules score the sample against their EWMA
+        estimator FIRST, then train it (so the judged deviation is
+        relative to history that does not yet include the sample —
+        and a sustained shift still becomes the new normal over
+        ~1/alpha samples, which is what lets a resolved regression
+        stop alerting without a restart). Returns None while an
+        anomaly rule is still calibrating: an untrained estimator can
+        neither fire nor vouch."""
+        if not isinstance(rule, AnomalyRule):
+            return v > rule.threshold
+        st = self._anomaly.setdefault(rule.name, [None, 0.0, 0, None])
+        mean, var, count, _ = st
+        verdict: Optional[bool] = None
+        if mean is not None and count >= rule.min_samples:
+            std = math.sqrt(var) if var > 0 else 0.0
+            d = v - mean
+            if std > 0:
+                z = d / std
+                st[3] = round(z, 4)
+                verdict = abs(z) > rule.z_threshold
+            else:
+                # a perfectly constant history: any movement is a
+                # deviation, but there is no finite z to report
+                st[3] = None
+                verdict = d != 0.0
+        if mean is None:
+            st[0], st[1] = float(v), 0.0
+        else:
+            a = rule.ewma_alpha
+            d = v - mean
+            st[0] = mean + a * d
+            st[1] = (1.0 - a) * (var + a * d * d)
+        st[2] = count + 1
+        return verdict
 
     @staticmethod
     def _burn(rule: SloRule, samples: list, now: float) -> Dict[float, Optional[float]]:
@@ -237,16 +379,31 @@ class SloMonitor:
         for rule in self.rules:
             since = self._firing[rule.name]
             burn = self._burn(rule, self._samples[rule.name], now)
-            out.append({
+            entry = {
                 "rule": rule.name, "metric": rule.metric,
-                "kind": rule.kind, "threshold": rule.threshold,
+                "kind": rule.kind,
+                "threshold": getattr(rule, "threshold", None),
                 "value": self._value[rule.name],
                 "firing": since is not None,
                 "since_s": (round(now - since, 3)
                             if since is not None else None),
                 "burn": {repr(w): (round(b, 4) if b is not None else None)
                          for w, b in burn.items()},
-            })
+            }
+            if isinstance(rule, AnomalyRule):
+                st = self._anomaly.get(rule.name)
+                entry["anomaly"] = {
+                    "z": st[3] if st else None,
+                    "z_threshold": rule.z_threshold,
+                    "mean": (round(st[0], 6)
+                             if st and st[0] is not None else None),
+                    "std": (round(math.sqrt(st[1]), 6)
+                            if st and st[1] > 0 else 0.0),
+                    "samples": st[2] if st else 0,
+                    "calibrating": (st is None
+                                    or st[2] < rule.min_samples),
+                }
+            out.append(entry)
         return out
 
     def alerts(self) -> List[dict]:

@@ -1,0 +1,122 @@
+"""BENCH trajectory guard: ``bench.py --check-regression`` compares the
+newest committed BENCH_r*.json against the median of its trailing
+predecessors — throughput keys within 15%, MFU within 10% — and reports
+keys that vanished from the fold. Pure-JSON tests, no accelerator."""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+from bench import (  # noqa: E402
+    _tolerance_for,
+    check_regression,
+    check_regression_cli,
+)
+
+
+def _doc(**parsed):
+    return {"parsed": parsed}
+
+
+def test_tolerance_selection():
+    assert _tolerance_for("mfu") == 0.10
+    assert _tolerance_for("decode_mfu") == 0.10
+    assert _tolerance_for("mfu_method") is None
+    assert _tolerance_for("tokens_per_sec") == 0.15
+    assert _tolerance_for("decode_tok_s") == 0.15
+    assert _tolerance_for("value") == 0.15
+    assert _tolerance_for("samples_per_sec") == 0.15
+    assert _tolerance_for("step_ms") is None  # latency is not guarded
+
+
+def test_within_tolerance_passes():
+    hist = [_doc(tokens_per_sec=100.0, mfu=0.50),
+            _doc(tokens_per_sec=110.0, mfu=0.52),
+            _doc(tokens_per_sec=90.0, mfu=0.48)]
+    out = check_regression(_doc(tokens_per_sec=95.0, mfu=0.47), hist)
+    assert out["regressions"] == [] and out["missing"] == []
+    assert out["baseline_runs"] == 3
+    checked = {c["key"]: c for c in out["checked"]}
+    assert checked["tokens_per_sec"]["median"] == 100.0
+    assert checked["mfu"]["tolerance"] == 0.10
+
+
+def test_throughput_drop_flags_regression():
+    hist = [_doc(tokens_per_sec=100.0)] * 3
+    out = check_regression(_doc(tokens_per_sec=50.0), hist)
+    assert [r["key"] for r in out["regressions"]] == ["tokens_per_sec"]
+    r = out["regressions"][0]
+    assert r["value"] == 50.0 and r["median"] == 100.0
+    assert r["floor"] == 85.0
+    # exactly at the floor is NOT a regression (strictly below fires)
+    out = check_regression(_doc(tokens_per_sec=85.0), hist)
+    assert out["regressions"] == []
+    out = check_regression(_doc(tokens_per_sec=84.9), hist)
+    assert len(out["regressions"]) == 1
+
+
+def test_missing_keys_reported_not_regressed():
+    hist = [_doc(tokens_per_sec=100.0, mfu=0.5)] * 2
+    out = check_regression(_doc(tokens_per_sec=100.0), hist)
+    assert out["regressions"] == []
+    assert [m["key"] for m in out["missing"]] == ["mfu"]
+    assert out["missing"][0]["median"] == 0.5
+
+
+def test_no_history_is_a_clean_pass():
+    out = check_regression(_doc(tokens_per_sec=100.0), [])
+    assert out == {"baseline_runs": 0, "checked": [],
+                   "regressions": [], "missing": []}
+
+
+def test_cli_end_to_end(tmp_path, capsys):
+    for i, tps in enumerate((100.0, 110.0, 90.0)):
+        (tmp_path / f"BENCH_r{i:02d}.json").write_text(
+            json.dumps(_doc(tokens_per_sec=tps, mfu=0.5)))
+    new = tmp_path / "BENCH_r03.json"
+    out_path = tmp_path / "cmp.json"
+    glob_pat = str(tmp_path / "BENCH_r*.json")
+
+    new.write_text(json.dumps(_doc(tokens_per_sec=97.0, mfu=0.49)))
+    rc = check_regression_cli(["--check-regression", str(new),
+                               "--history", glob_pat,
+                               "--out", str(out_path)])
+    assert rc == 0
+    art = json.loads(out_path.read_text())
+    assert art["regressions"] == []
+    # the checked file never baselines itself
+    assert "BENCH_r03.json" not in art["history_files"]
+    assert len(art["history_files"]) == 3
+
+    new.write_text(json.dumps(_doc(tokens_per_sec=40.0, mfu=0.49)))
+    rc = check_regression_cli(["--check-regression", str(new),
+                               "--history", glob_pat])
+    assert rc == 1
+    assert "REGRESSION" in capsys.readouterr().out
+
+    with pytest.raises(SystemExit) as e:
+        check_regression_cli(
+            ["--check-regression", str(tmp_path / "nope.json"),
+             "--history", glob_pat])
+    assert e.value.code == 2
+    assert "cannot read" in capsys.readouterr().err
+
+
+def test_cli_window_limits_history(tmp_path):
+    # 5 old runs at 200, then 3 recent at 100: window=3 baselines on
+    # the recent plateau, so 95 is healthy (vs the stale 200 era)
+    for i, tps in enumerate((200.0,) * 5 + (100.0,) * 3):
+        (tmp_path / f"BENCH_r{i:02d}.json").write_text(
+            json.dumps(_doc(tokens_per_sec=tps)))
+    new = tmp_path / "BENCH_r08.json"
+    new.write_text(json.dumps(_doc(tokens_per_sec=95.0)))
+    rc = check_regression_cli(["--check-regression", str(new),
+                               "--history",
+                               str(tmp_path / "BENCH_r*.json"),
+                               "--window", "3"])
+    assert rc == 0

@@ -137,7 +137,7 @@ def test_protocol_extraction_matches_dispatch():
     assert ops == {"generate", "stats", "metrics", "trace_dump",
                    "chrome_trace", "flight", "alerts", "drain",
                    "reconfigure", "export_kv", "import_kv",
-                   "push_weights"}
+                   "push_weights", "timeseries", "events"}
     assert set(proto.router.arms) == ops
     assert set(proto.client.ops) == ops
     assert proto.server.has_unknown_arm and proto.router.has_unknown_arm

@@ -566,3 +566,205 @@ def test_metrics_writer_records_snapshot_takes_the_lock():
         w._lock = real
     assert len(recs) == 1 and recs[0]["loss"] == 0.5
     assert acquired, ".records must snapshot under the writer lock"
+
+
+# -- snapshot lock discipline (PR 20 satellite) -----------------------------
+
+
+def test_histogram_percentile_and_tail_exemplar_one_lock_hold():
+    """Regression (lock-discipline fix): percentile() and
+    tail_exemplar() each copy everything they need in ONE lock hold —
+    a copy split across two acquisitions could pair bucket counts from
+    one observe with the total count of the next (the
+    FlightRecorder.meta torn-read shape). Asserted with a counting
+    probe lock, like the MetricsWriter test."""
+    reg = telemetry.MetricRegistry()
+    h = reg.histogram("lat_ms", "l", buckets=(1.0, 10.0, 100.0))
+    for v in (0.5, 5.0, 50.0):
+        h.observe(v, exemplar=f"t{v}")
+    real = h._lock
+    acquired = []
+
+    class ProbeLock:
+        def __enter__(self):
+            acquired.append(True)
+            return real.__enter__()
+
+        def __exit__(self, *exc):
+            return real.__exit__(*exc)
+
+    h._lock = ProbeLock()
+    try:
+        p = h.percentile(99.0)
+        assert len(acquired) == 1, (
+            "percentile() must copy counts+n under one lock hold")
+        acquired.clear()
+        ex = h.tail_exemplar()
+        assert len(acquired) == 1, (
+            "tail_exemplar() must read bucket state under one lock hold")
+    finally:
+        h._lock = real
+    assert 10.0 < p <= 100.0
+    assert ex == {"value": 50.0, "trace_id": "t50.0", "le": "+Inf"} or \
+        ex["trace_id"] == "t50.0"
+
+
+def test_registry_collect_single_registry_lock_hold():
+    """collect() captures the name->metric map in ONE registry-lock
+    hold, then snapshots each metric with no nested holds: a slow
+    histogram render never blocks registration, and a concurrent
+    registration lands wholly before or wholly after the capture."""
+    reg = telemetry.MetricRegistry()
+    reg.counter("a_total", "a").inc()
+    reg.gauge("b", "b").set(2)
+    reg.histogram("c_ms", "c", buckets=(1.0,)).observe(0.5)
+    real = reg._lock
+    acquired = []
+
+    class ProbeLock:
+        def __enter__(self):
+            acquired.append(True)
+            return real.__enter__()
+
+        def __exit__(self, *exc):
+            return real.__exit__(*exc)
+
+    reg._lock = ProbeLock()
+    try:
+        snap = reg.collect()
+    finally:
+        reg._lock = real
+    assert len(acquired) == 1, (
+        "collect() must capture the metric map in exactly one "
+        "registry-lock hold")
+    assert set(snap) == {"a_total", "b", "c_ms"}
+
+
+def test_registration_during_collect_does_not_deadlock():
+    """Because collect() releases the registry lock before snapshotting,
+    a metric whose snapshot path registers something new (metrics
+    about metrics — e.g. the TimeSeriesStore's own overhead gauge)
+    cannot deadlock against it."""
+    reg = telemetry.MetricRegistry()
+    h = reg.histogram("lat_ms", "l", buckets=(1.0,))
+    h.observe(0.5)
+    orig = h.snapshot
+
+    def registering_snapshot():
+        reg.counter("registered_mid_collect_total", "r").inc()
+        return orig()
+
+    h.snapshot = registering_snapshot
+    done = []
+    t = threading.Thread(target=lambda: done.append(reg.collect()))
+    t.start()
+    t.join(timeout=10.0)
+    assert done, "collect() deadlocked against a concurrent registration"
+    assert "lat_ms" in done[0]
+    # the registration landed and the next collect sees it
+    assert "registered_mid_collect_total" in reg.collect()
+
+
+# -- exemplar exposition edge cases (PR 20 satellite) -----------------------
+
+
+def test_exemplars_render_only_under_openmetrics():
+    """Exemplar annotations are OpenMetrics-only: the plain text-format
+    output is byte-identical to an exemplar-free registry's, so the
+    PR-5 scrape parseability guarantees hold untouched."""
+    with_ex = telemetry.MetricRegistry()
+    without = telemetry.MetricRegistry()
+    for reg, tid in ((with_ex, "trace-7"), (without, None)):
+        h = reg.histogram("lat_ms", "l", buckets=(1.0, 10.0))
+        h.observe(0.5, exemplar=tid)
+        h.observe(5.0, exemplar=tid)
+    plain = telemetry.render_prometheus(with_ex)
+    assert "# {" not in plain
+    assert plain == telemetry.render_prometheus(without)
+    om = telemetry.render_prometheus(with_ex, openmetrics=True)
+    line = [ln for ln in om.splitlines()
+            if ln.startswith("lat_ms_bucket") and 'le="10.0"' in ln]
+    assert len(line) == 1
+    assert line[0].endswith('# {trace_id="trace-7"} 5')
+
+
+def test_exemplar_trace_id_label_escaping():
+    reg = telemetry.MetricRegistry()
+    h = reg.histogram("lat_ms", "l", buckets=(1.0,))
+    h.observe(0.5, exemplar='id "x\\y"\nz')
+    om = telemetry.render_prometheus(reg, openmetrics=True)
+    assert r'trace_id="id \"x\\y\"\nz"' in om
+    # the raw newline never leaks into the exposition
+    for ln in om.splitlines():
+        assert not ln.endswith('"nz"')
+    assert "\nz\"" not in om
+
+
+def test_exemplar_out_of_range_lands_in_inf_bucket():
+    reg = telemetry.MetricRegistry()
+    h = reg.histogram("lat_ms", "l", buckets=(1.0, 10.0))
+    h.observe(1e9, exemplar="way-out")
+    om = telemetry.render_prometheus(reg, openmetrics=True)
+    inf_line = [ln for ln in om.splitlines()
+                if ln.startswith("lat_ms_bucket") and 'le="+Inf"' in ln]
+    assert len(inf_line) == 1
+    assert 'trace_id="way-out"' in inf_line[0]
+    # the finite buckets carry no exemplar
+    assert sum("# {" in ln for ln in om.splitlines()) == 1
+    assert h.tail_exemplar() == {
+        "value": 1e9, "trace_id": "way-out", "le": "+Inf"}
+
+
+def test_exemplar_last_observation_wins_per_bucket():
+    reg = telemetry.MetricRegistry()
+    h = reg.histogram("lat_ms", "l", buckets=(10.0,))
+    h.observe(1.0, exemplar="first")
+    h.observe(2.0, exemplar="second")
+    h.observe(3.0)  # exemplar-free observations don't evict one
+    om = telemetry.render_prometheus(reg, openmetrics=True)
+    assert 'trace_id="second"' in om and 'trace_id="first"' not in om
+    assert h.tail_exemplar()["trace_id"] == "second"
+
+
+def test_openmetrics_scrape_stays_parseable_with_exemplars():
+    """The PR-5 parseability contract extended to OpenMetrics output:
+    stripping the exemplar annotation from every sample line leaves a
+    parseable number, and bucket counts stay monotone."""
+    reg = telemetry.MetricRegistry()
+    h = reg.histogram("lat_ms", "l", buckets=(1.0, 10.0, 100.0))
+    for i, v in enumerate((0.5, 5.0, 50.0, 500.0)):
+        h.observe(v, exemplar=f"t{i}")
+    reg.counter("ops_total", "o", labelnames=("op",)).labels(
+        op='we"ird').inc()
+    om = telemetry.render_prometheus(reg, openmetrics=True)
+    buckets = []
+    for line in om.splitlines():
+        if line.startswith("#") or not line:
+            continue
+        sample = line.split(" # {")[0]
+        float(sample.rsplit(" ", 1)[1])
+        if line.startswith("lat_ms_bucket"):
+            buckets.append(int(sample.rsplit(" ", 1)[1]))
+    assert buckets == sorted(buckets)
+
+
+def test_http_metrics_openmetrics_negotiation():
+    """?openmetrics=1 flips the content type and turns exemplars on;
+    the default scrape stays plain text-format."""
+    reg = telemetry.MetricRegistry()
+    reg.histogram("lat_ms", "l", buckets=(1.0,)).observe(
+        0.5, exemplar="t1")
+    srv = telemetry.TelemetryServer(registry=reg).start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}/metrics"
+        with urllib.request.urlopen(base, timeout=10) as resp:
+            assert resp.headers["Content-Type"].startswith(
+                "text/plain")
+            assert "# {" not in resp.read().decode()
+        with urllib.request.urlopen(base + "?openmetrics=1",
+                                    timeout=10) as resp:
+            assert resp.headers["Content-Type"].startswith(
+                "application/openmetrics-text")
+            assert 'trace_id="t1"' in resp.read().decode()
+    finally:
+        srv.stop()

@@ -1,0 +1,634 @@
+"""Time-series observability plane: the metric-history ring
+(TimeSeriesStore), the control-plane event journal, anomaly rules, the
+fleet merges, the ``timeseries``/``events`` wire ops, and the
+``report --timeline`` / ``--live`` renderers.
+
+Deterministic throughout: stores sample with injected ``now``/``wall``
+clocks, anomaly polls replay injected timelines, and the wire tests use
+the same tiny in-process model as test_telemetry.py.
+"""
+
+import io
+import json
+import threading
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distkeras_tpu import telemetry
+from distkeras_tpu.telemetry import report as telemetry_report
+from distkeras_tpu.telemetry.events import (
+    KNOWN_ACTIONS,
+    EventJournal,
+    FleetEvent,
+    merge_event_journals,
+)
+from distkeras_tpu.telemetry.timeseries import (
+    TimeSeriesStore,
+    base_family,
+    merge_timeseries,
+    series_key,
+    write_timeline,
+)
+
+KW = dict(vocab_size=64, d_model=32, num_heads=2, num_layers=2,
+          max_len=48, dtype=jnp.float32, attention="dense")
+
+
+def _model_and_params(seed=0):
+    from distkeras_tpu.models import get_model
+
+    model = get_model("transformer_lm", **KW)
+    params = model.init(jax.random.PRNGKey(seed),
+                        jnp.zeros((1, 4), jnp.int32))
+    return model, params
+
+
+# -- series keys ------------------------------------------------------------
+
+
+def test_series_key_and_base_family_roundtrip():
+    assert series_key("up", {}) == "up"
+    k = series_key("lat_ms", {"op": "pull", "host": "a"})
+    assert k == 'lat_ms{op="pull",host="a"}'
+    assert base_family(k) == "lat_ms"
+    assert base_family("tokens_total:rate") == "tokens_total"
+    assert base_family('lat_ms{op="a"}:p99') == "lat_ms"
+    assert base_family("queue_depth") == "queue_depth"
+    # label values escape like the Prometheus exposition
+    weird = series_key("m", {"k": 'a"b\\c\nd'})
+    assert '\\"' in weird and "\\\\" in weird and "\\n" in weird
+
+
+# -- TimeSeriesStore --------------------------------------------------------
+
+
+def _seeded_registry():
+    reg = telemetry.MetricRegistry()
+    c = reg.counter("toks_total", "t")
+    g = reg.gauge("depth", "d")
+    h = reg.histogram("lat_ms", "l", buckets=(1.0, 10.0, 100.0))
+    return reg, c, g, h
+
+
+def test_store_reduces_counters_gauges_histograms():
+    reg, c, g, h = _seeded_registry()
+    ts = TimeSeriesStore(registry=reg, interval_s=1.0)
+    c.inc(10)
+    g.set(3)
+    h.observe(5.0)
+    p0 = ts.sample(now=100.0, wall=1000.0)
+    # first point: no previous snapshot, so no rate yet; gauges and
+    # the (empty-delta) histogram count land immediately
+    assert "toks_total:rate" not in p0["series"]
+    assert p0["series"]["depth"] == 3
+    assert p0["dt"] is None
+    c.inc(20)
+    g.set(7)
+    for v in (2.0, 5.0, 50.0, 50.0):
+        h.observe(v)
+    p1 = ts.sample(now=102.0, wall=1002.0)
+    assert p1["dt"] == 2.0
+    assert p1["series"]["toks_total:rate"] == pytest.approx(10.0)
+    assert p1["series"]["depth"] == 7
+    # windowed stats cover ONLY this interval's 4 observations
+    assert p1["series"]["lat_ms:count"] == 4
+    assert 0 < p1["series"]["lat_ms:p50"] <= 10.0
+    assert 10.0 < p1["series"]["lat_ms:p99"] <= 100.0
+
+
+def test_store_counter_reset_clamps_rate():
+    reg, c, g, h = _seeded_registry()
+    ts = TimeSeriesStore(registry=reg)
+    c.inc(100)
+    ts.sample(now=1.0, wall=1.0)
+    # a replica restart re-registers at 0: the delta is negative and
+    # the rate clamps to 0 instead of going negative
+    c._series[()] = 0.0
+    p = ts.sample(now=2.0, wall=2.0)
+    assert p["series"]["toks_total:rate"] == 0.0
+
+
+def test_store_ring_capacity_and_dropped():
+    reg, c, g, h = _seeded_registry()
+    ts = TimeSeriesStore(registry=reg, capacity=3)
+    for i in range(5):
+        g.set(i)
+        ts.sample(now=float(i), wall=float(i))
+    pts = ts.points()
+    assert len(pts) == 3
+    assert [p["series"]["depth"] for p in pts] == [2, 3, 4]
+    assert ts.points(last=1)[0]["series"]["depth"] == 4
+    m = ts.meta()
+    assert m["recorded"] == 3 and m["dropped"] == 2
+    assert m["samples"] == 5 and m["capacity"] == 3
+    assert ts.series("depth") == [(2.0, 2), (3.0, 3), (4.0, 4)]
+
+
+def test_store_validation():
+    with pytest.raises(ValueError):
+        TimeSeriesStore(registry=telemetry.MetricRegistry(), capacity=0)
+    with pytest.raises(ValueError):
+        TimeSeriesStore(registry=telemetry.MetricRegistry(),
+                        interval_s=0.0)
+
+
+def test_store_collector_thread_and_overhead():
+    reg, c, g, h = _seeded_registry()
+    ts = TimeSeriesStore(registry=reg, interval_s=0.01)
+    ts.start()
+    try:
+        deadline = 100
+        while ts.meta()["samples"] < 3 and deadline:
+            threading.Event().wait(0.01)
+            deadline -= 1
+    finally:
+        ts.stop()
+    m = ts.meta()
+    assert m["samples"] >= 3
+    # the collector times itself; on a real cadence the sampling cost
+    # is a tiny fraction of wall time
+    assert 0.0 <= m["overhead_frac"] < 0.5
+    ts.stop()  # idempotent
+
+
+def test_store_sample_reduces_and_appends_in_one_lock_hold():
+    """Regression (lock-discipline): the reduce-against-previous and
+    the ring append happen in ONE store-lock hold, so a concurrent
+    sampler can never pair a point with the wrong baseline snapshot.
+    Asserted with a counting probe lock, like the MetricsWriter test."""
+    reg, c, g, h = _seeded_registry()
+    ts = TimeSeriesStore(registry=reg)
+    g.set(1)
+    real = ts._lock
+    acquired = []
+
+    class ProbeLock:
+        def __enter__(self):
+            acquired.append(True)
+            return real.__enter__()
+
+        def __exit__(self, *exc):
+            return real.__exit__(*exc)
+
+    ts._lock = ProbeLock()
+    try:
+        ts.sample(now=1.0, wall=1.0)
+    finally:
+        ts._lock = real
+    assert len(acquired) == 1, (
+        "sample() must reduce and append under exactly one lock hold")
+
+
+# -- fleet merge ------------------------------------------------------------
+
+
+def _pt(t, **series):
+    return {"t": t, "dt": 1.0, "series": series}
+
+
+def test_merge_timeseries_sum_vs_max_policy():
+    merged = merge_timeseries({
+        "r0": [_pt(10.2, **{"toks_total:rate": 100.0,
+                            "lat_ms:p99": 40.0, "lat_ms:count": 5,
+                            "depth": 2.0, "weight_version": 3.0})],
+        "r1": [_pt(10.7, **{"toks_total:rate": 50.0,
+                            "lat_ms:p99": 90.0, "lat_ms:count": 7,
+                            "depth": 1.0, "weight_version": 4.0})],
+    }, bucket_s=1.0, max_families=("weight_version",))
+    assert len(merged) == 1
+    s = merged[0]["series"]
+    assert s["toks_total:rate"] == 150.0      # rates SUM
+    assert s["lat_ms:count"] == 12            # counts SUM
+    assert s["lat_ms:p99"] == 90.0            # percentiles MAX
+    assert s["depth"] == 3.0                  # gauges SUM by default
+    assert s["weight_version"] == 4.0         # max-family gauge MAX
+    assert merged[0]["sources"] == ["r0", "r1"]
+
+
+def test_merge_timeseries_buckets_and_latest_point_wins():
+    merged = merge_timeseries({
+        "r0": [_pt(10.1, depth=1.0), _pt(10.9, depth=5.0),
+               _pt(12.0, depth=9.0)],
+    }, bucket_s=1.0)
+    assert [m["t"] for m in merged] == [10.0, 12.0]
+    # within one bucket each source contributes its LATEST point only
+    assert merged[0]["series"]["depth"] == 5.0
+    with pytest.raises(ValueError):
+        merge_timeseries({}, bucket_s=0.0)
+
+
+# -- event journal ----------------------------------------------------------
+
+
+def test_event_journal_append_and_ring():
+    j = EventJournal(capacity=3, actor="engine")
+    e = j.append("drain", queued=4, t=10.0)
+    assert e == {"t": 10.0, "actor": "engine", "action": "drain",
+                 "target": None, "queued": 4}
+    j.append("undrain", t=11.0)
+    j.append("weight_push", version=2, actor="ckpt_watcher", t=12.0)
+    j.append("reconfigure", target="decode", t=13.0)
+    evs = j.events()
+    assert len(evs) == 3 and j.dropped == 1
+    assert [e["action"] for e in evs] == ["undrain", "weight_push",
+                                          "reconfigure"]
+    assert evs[1]["actor"] == "ckpt_watcher"
+    assert j.events(last=1)[0]["action"] == "reconfigure"
+    assert j.meta() == {"recorded": 3, "dropped": 1, "capacity": 3,
+                        "actor": "engine"}
+    # returned dicts are copies: annotating one must not mutate the ring
+    evs[0]["source"] = "x"
+    assert "source" not in j.events()[0]
+    with pytest.raises(ValueError):
+        EventJournal(capacity=0)
+
+
+def test_fleet_event_roundtrip_and_known_actions():
+    e = FleetEvent(t=1.0, actor="router", action="scale_up",
+                   target="r1", detail={"reason": "queue"})
+    d = e.to_dict()
+    assert d["reason"] == "queue"
+    assert FleetEvent.from_dict(d) == e
+    # the journal hooks across the stack only use known actions
+    assert {"scale_up", "scale_down", "drain", "undrain", "weight_push",
+            "rollback", "kv_migrate", "replica_up", "replica_down",
+            "reconfigure", "rebalance"} <= KNOWN_ACTIONS
+
+
+def test_merge_event_journals_orders_and_tags_source():
+    merged = merge_event_journals({
+        "r1": [{"t": 2.0, "actor": "engine", "action": "drain"}],
+        "router": [{"t": 1.0, "actor": "router", "action": "scale_up"},
+                   {"t": 2.0, "actor": "router", "action": "undrain"}],
+    })
+    assert [(e["t"], e["source"]) for e in merged] == [
+        (1.0, "router"), (2.0, "r1"), (2.0, "router")]
+    assert merged[0]["action"] == "scale_up"
+
+
+# -- anomaly rules ----------------------------------------------------------
+
+
+def test_anomaly_rule_validation():
+    from distkeras_tpu.telemetry import AnomalyRule
+
+    with pytest.raises(ValueError):
+        AnomalyRule("a", "m", kind="p42")
+    with pytest.raises(ValueError):
+        AnomalyRule("a", "m", ewma_alpha=0.0)
+    with pytest.raises(ValueError):
+        AnomalyRule("a", "m", z_threshold=0.0)
+    with pytest.raises(ValueError):
+        AnomalyRule("a", "m", min_samples=1)
+
+
+def test_default_anomaly_rules_names_feed_autoscaler_matching():
+    rules = telemetry.default_anomaly_rules()
+    names = [r.name for r in rules]
+    assert names == ["itl_p99_anomaly", "ttft_p99_anomaly",
+                     "queue_depth_anomaly", "blocks_in_use_anomaly"]
+    # the autoscaler's burn matching looks for these substrings
+    assert any("itl" in n for n in names)
+    assert any("ttft" in n for n in names)
+
+
+def test_anomaly_calibrates_fires_and_relearns():
+    """The EWMA detector's full life cycle on an injected timeline:
+    silent while calibrating, fires on a z-score deviation, then the
+    sustained shift becomes the new normal and the alert resolves."""
+    from distkeras_tpu.telemetry import AnomalyRule
+
+    reg = telemetry.MetricRegistry()
+    g = reg.gauge("depth", "d")
+    rule = AnomalyRule("depth_anomaly", "depth", "gauge",
+                       ewma_alpha=0.05, z_threshold=3.0, min_samples=10,
+                       windows=(2.0, 4.0), burn_threshold=0.5)
+    mon = telemetry.SloMonitor([rule], registry=reg,
+                               tracer=telemetry.Tracer())
+    now = 0.0
+    # calibration + steady state: a deterministic 10+-0.5 oscillation
+    # (z stabilizes ~1, well under the threshold) — never fires
+    for i in range(20):
+        g.set(10.0 + (0.5 if i % 2 else -0.5))
+        now += 1.0
+        (a,) = mon.poll(now=now)
+        assert not a["firing"]
+    assert not a["anomaly"]["calibrating"]
+    assert a["anomaly"]["mean"] == pytest.approx(10.0, abs=1.5)
+    # 10x burst: deviates hard, burns both windows, fires
+    fired = False
+    for _ in range(8):
+        g.set(100.0)
+        now += 1.0
+        (a,) = mon.poll(now=now)
+        fired = fired or a["firing"]
+    assert fired
+    assert reg.counter("slo_alerts_total", labelnames=("rule",)).labels(
+        rule="depth_anomaly").value == 1
+    # the shift sustained: EWMA absorbs it and the alert resolves
+    # (no restart needed after a resolved regression)
+    for _ in range(60):
+        g.set(100.0)
+        now += 1.0
+        (a,) = mon.poll(now=now)
+    assert not a["firing"]
+    assert a["anomaly"]["mean"] == pytest.approx(100.0, abs=5.0)
+
+
+def test_anomaly_and_threshold_rules_share_one_monitor():
+    from distkeras_tpu.telemetry import AnomalyRule, SloRule
+
+    reg = telemetry.MetricRegistry()
+    reg.gauge("depth", "d").set(1.0)
+    mon = telemetry.SloMonitor(
+        [SloRule("depth_max", "depth", "gauge", 100.0),
+         AnomalyRule("depth_anomaly", "depth", "gauge")],
+        registry=reg, tracer=telemetry.Tracer())
+    alerts = {a["rule"]: a for a in mon.poll(now=1.0)}
+    assert set(alerts) == {"depth_max", "depth_anomaly"}
+    assert alerts["depth_max"]["threshold"] == 100.0
+    assert alerts["depth_anomaly"]["threshold"] is None
+    assert alerts["depth_anomaly"]["anomaly"]["calibrating"]
+
+
+# -- timeline artifact + report CLI -----------------------------------------
+
+
+def _timeline_fixture(tmp_path):
+    reg, c, g, h = _seeded_registry()
+    ts = TimeSeriesStore(registry=reg)
+    j = EventJournal(actor="router")
+    for i in range(10):
+        c.inc(10 + i)
+        g.set(i)
+        h.observe(float(i + 1))
+        ts.sample(now=float(i), wall=1000.0 + i)
+    j.append("scale_up", target="r1", actor="autoscaler",
+             reason="queue", t=1004.5)
+    j.append("weight_push", version=2, t=1008.2)
+    path = str(tmp_path / "timeline.jsonl")
+    write_timeline(path, ts.points(), j.events(), meta=ts.meta())
+    return path
+
+
+def test_write_timeline_and_report_renders(tmp_path, capsys):
+    path = _timeline_fixture(tmp_path)
+    telemetry_report.main([path, "--timeline"])
+    out = capsys.readouterr().out
+    assert "timeline: 10 points, 2 events" in out
+    assert "toks_total:rate" in out
+    assert "scale_up" in out and "weight_push" in out
+    assert "[autoscaler]" in out
+    # events ruler row is on the same axis as the sparklines
+    assert "events" in out
+    # --series filters the sparklines
+    telemetry_report.main([path, "--timeline", "--series", "depth"])
+    out = capsys.readouterr().out
+    assert "depth" in out and "toks_total:rate" not in out
+
+
+def test_report_timeline_exit2_contract(tmp_path, capsys):
+    # missing file
+    with pytest.raises(SystemExit) as e:
+        telemetry_report.main([str(tmp_path / "nope.jsonl"),
+                               "--timeline"])
+    assert e.value.code == 2
+    assert capsys.readouterr().err.startswith("error: ")
+    # corrupt JSONL
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text("not json\n")
+    with pytest.raises(SystemExit) as e:
+        telemetry_report.main([str(bad), "--timeline"])
+    assert e.value.code == 2
+    err = capsys.readouterr().err
+    assert err.startswith("error: ") and ":1:" in err
+    # a trace JSONL fed to --timeline: one-line redirect, not a crash
+    spans = tmp_path / "spans.jsonl"
+    spans.write_text(json.dumps(
+        {"trace": 1, "span": "decode", "t0": 0.0, "ms": 1.0}) + "\n")
+    with pytest.raises(SystemExit) as e:
+        telemetry_report.main([str(spans), "--timeline"])
+    assert e.value.code == 2
+    assert "no point or event records" in capsys.readouterr().err
+    # malformed point record: diagnosed, not crashed
+    malformed = tmp_path / "malformed.jsonl"
+    malformed.write_text(json.dumps({"point": {"series": {}}}) + "\n")
+    with pytest.raises(SystemExit) as e:
+        telemetry_report.main([str(malformed), "--timeline"])
+    assert e.value.code == 2
+    assert "missing t/series" in capsys.readouterr().err
+    # --series matching nothing: a one-line error, not empty output
+    good = _timeline_fixture(tmp_path)
+    with pytest.raises(SystemExit) as e:
+        telemetry_report.main([good, "--timeline", "--series", "zzz"])
+    assert e.value.code == 2
+
+
+def test_report_requires_path_or_live(capsys):
+    with pytest.raises(SystemExit) as e:
+        telemetry_report.main(["--timeline"])
+    assert e.value.code == 2
+
+
+def test_report_live_polls_telemetry_server(capsys):
+    reg, c, g, h = _seeded_registry()
+    ts = TimeSeriesStore(registry=reg)
+    j = EventJournal(actor="router")
+    for i in range(5):
+        g.set(i)
+        ts.sample(now=float(i), wall=100.0 + i)
+    j.append("drain", t=102.5)
+    srv = telemetry.TelemetryServer(registry=reg, timeseries=ts,
+                                    events=j).start()
+    try:
+        telemetry_report.main(
+            ["--live", f"127.0.0.1:{srv.port}", "--polls", "1"])
+        out = capsys.readouterr().out
+        assert "timeline: 5 points, 1 events" in out
+        assert "drain" in out
+        # unwired store: HTTP 404 becomes the one-line exit-2 error
+        bare = telemetry.TelemetryServer(registry=reg).start()
+        try:
+            with pytest.raises(SystemExit) as e:
+                telemetry_report.main(
+                    ["--live", f"127.0.0.1:{bare.port}", "--polls", "1"])
+            assert e.value.code == 2
+            assert "HTTP 404" in capsys.readouterr().err
+        finally:
+            bare.stop()
+    finally:
+        srv.stop()
+    # unreachable endpoint
+    with pytest.raises(SystemExit) as e:
+        telemetry_report.main(["--live", "127.0.0.1:9", "--polls", "1"])
+    assert e.value.code == 2
+
+
+def test_http_timeseries_and_events_routes():
+    reg, c, g, h = _seeded_registry()
+    ts = TimeSeriesStore(registry=reg)
+    j = EventJournal()
+    for i in range(4):
+        g.set(i)
+        ts.sample(now=float(i), wall=float(i))
+    j.append("drain", t=1.0)
+    j.append("undrain", t=2.0)
+    srv = telemetry.TelemetryServer(registry=reg, timeseries=ts,
+                                    events=j).start()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/timeseries?last=2") as r:
+            doc = json.loads(r.read())
+        assert doc["meta"]["samples"] == 4
+        assert len(doc["points"]) == 2
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/events?last=1") as r:
+            doc = json.loads(r.read())
+        assert doc["meta"]["recorded"] == 2
+        assert [e["action"] for e in doc["events"]] == ["undrain"]
+    finally:
+        srv.stop()
+
+
+# -- wire ops + journal hooks through the serving stack ---------------------
+
+
+def test_server_timeseries_and_events_ops():
+    from distkeras_tpu.serving import LMServer, ServingClient, ServingEngine
+
+    model, params = _model_and_params()
+    reg, tr = telemetry.MetricRegistry(), telemetry.Tracer()
+    eng = ServingEngine(model, params, slots=2, registry=reg, tracer=tr)
+    lm = LMServer(eng).start()
+    try:
+        client = ServingClient("127.0.0.1", lm.port)
+        rid = client.generate(list(range(1, 6)), max_new_tokens=4)
+        toks, reason = client.result(rid, timeout=60)
+        assert reason == "length"
+        # force two points so rates exist regardless of collector timing
+        lm.timeseries.sample()
+        lm.timeseries.sample()
+        ts = client.timeseries()
+        assert ts["meta"]["samples"] >= 2
+        keys = set().union(*(p["series"] for p in ts["points"]))
+        assert any(k.startswith("serving_tokens_total") for k in keys)
+        assert client.timeseries(last=1)["points"][0] == ts["points"][-1]
+
+        # journal hooks: drain/undrain/reconfigure/weight_push all land
+        client.drain()
+        client.undrain()
+        client.reconfigure("decode")
+        ev = client.events()
+        actions = [e["action"] for e in ev["events"]]
+        assert actions == ["drain", "undrain", "reconfigure"]
+        assert ev["events"][2]["target"] == "decode"
+        assert ev["meta"]["actor"] == "engine"
+        assert client.events(last=1)["events"][0]["action"] == \
+            "reconfigure"
+        # idempotent transitions don't spam the journal
+        client.reconfigure("decode")
+        assert len(client.events()["events"]) == 3
+        client.close()
+    finally:
+        lm.stop()
+
+
+def test_server_timeseries_disabled_refuses():
+    from distkeras_tpu.serving import LMServer, ServingClient, ServingEngine
+
+    model, params = _model_and_params()
+    eng = ServingEngine(model, params, slots=1,
+                        registry=telemetry.MetricRegistry(),
+                        tracer=telemetry.Tracer())
+    lm = LMServer(eng, timeseries=False).start()
+    try:
+        client = ServingClient("127.0.0.1", lm.port)
+        with pytest.raises(RuntimeError, match="disabled"):
+            client.timeseries()
+        # the journal is unconditional: events still answers
+        assert client.events()["events"] == []
+        client.close()
+    finally:
+        lm.stop()
+
+
+def test_weight_push_lands_in_engine_journal():
+    from distkeras_tpu.serving import LMServer, ServingClient, ServingEngine
+
+    model, params = _model_and_params()
+    eng = ServingEngine(model, params, slots=1,
+                        registry=telemetry.MetricRegistry(),
+                        tracer=telemetry.Tracer())
+    lm = LMServer(eng, timeseries=False).start()
+    try:
+        client = ServingClient("127.0.0.1", lm.port)
+        client.push_weights(params, version=7)
+        evs = client.events()["events"]
+        assert [e["action"] for e in evs] == ["weight_push"]
+        assert evs[0]["version"] == 7
+        assert evs[0]["swap_ms"] >= 0
+        client.close()
+    finally:
+        lm.stop()
+
+
+def test_router_merges_fleet_timeseries_and_events():
+    from distkeras_tpu.serving import LMServer, Router, ServingClient, \
+        ServingEngine
+
+    model, params = _model_and_params()
+    servers = []
+    for i in range(2):
+        eng = ServingEngine(model, params, slots=1,
+                            registry=telemetry.MetricRegistry(),
+                            tracer=telemetry.Tracer(pid=100 + i))
+        servers.append(LMServer(eng).start())
+    router = Router(
+        [("127.0.0.1", s.port, f"r{i}")
+         for i, s in enumerate(servers)],
+        registry=telemetry.MetricRegistry(),
+        tracer=telemetry.Tracer(pid=1),
+    ).start()
+    try:
+        client = ServingClient("127.0.0.1", router.port)
+        rid = client.generate(list(range(1, 6)), max_new_tokens=3)
+        client.result(rid, timeout=60)
+        for s in servers:
+            s.timeseries.sample()
+            s.timeseries.sample()
+        router.timeseries.sample()
+
+        ts = client.timeseries()
+        assert set(ts["meta"]["sources"]) == {"r0", "r1", "router"}
+        assert ts["points"], "merged ring must not be empty"
+        assert all("sources" in p for p in ts["points"])
+
+        # every routable replica plus the router shows up in the
+        # fleet journal view, timestamp-ordered and source-tagged
+        ev = client.events()
+        assert set(ev["meta"]["sources"]) == {"r0", "r1", "router"}
+        client.drain(replica="r0")
+        ev = client.events()
+        evs = ev["events"]
+        # a draining replica stops being routable, so it leaves the
+        # fleet view — but the router's own journal records the drain
+        assert set(ev["meta"]["sources"]) == {"r1", "router"}
+        assert [e["t"] for e in evs] == sorted(e["t"] for e in evs)
+        drains = [e for e in evs if e["action"] == "drain"]
+        assert [(e["source"], e["target"], e["reason"])
+                for e in drains] == [("router", "r0", "admin")]
+        # the replica's engine journaled the actual transition too —
+        # visible on a direct connection even while unroutable
+        direct = ServingClient("127.0.0.1", servers[0].port)
+        r0_evs = direct.events()["events"]
+        assert [e["action"] for e in r0_evs] == ["drain"]
+        direct.close()
+        client.close()
+    finally:
+        router.stop()
+        for s in servers:
+            s.stop()
